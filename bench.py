@@ -1,0 +1,79 @@
+"""Benchmark: GPT causal-LM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor (BASELINE.md): the reference publishes no in-repo numbers;
+the driver-defined north star is GPT MFU.  We report tokens/sec/chip for a
+GPT-125M-class model with the compiled train step, plus model FLOPs
+utilization computed from 6*N*T FLOPs/token.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import DistributedTrainStep, fleet
+    from paddle_tpu.jit import CompiledTrainStep
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    # GPT-125M-class, bf16 on TPU
+    if on_tpu:
+        cfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
+                                  dtype="bfloat16",
+                                  use_flash_attention=True)
+        batch, seq = 8, 1024
+    else:  # CPU fallback so the bench always produces a line
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128,
+                        use_flash_attention=False)
+        batch, seq = 2, 128
+
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    ids = paddle.randint(0, cfg.vocab_size, [batch, seq])
+    labels = paddle.randint(0, cfg.vocab_size, [batch, seq])
+
+    def loss_fn(m, x, l):
+        return crit(m(x), l)
+
+    step = CompiledTrainStep(model, loss_fn, opt)
+    # warmup / compile (2 structures: empty accs then full)
+    step(ids, labels)
+    step(ids, labels)
+    loss = step(ids, labels)
+    loss.numpy()
+
+    iters = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    loss.numpy()  # sync
+    dt = time.perf_counter() - t0
+    tokens_per_sec = batch * seq * iters / dt
+
+    # MFU: 6*N FLOPs per token (fwd+bwd) / peak
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6 * n_params
+    peak = 394e12 if on_tpu else 1e12  # v5e bf16 peak ~394 TFLOPs
+    mfu = tokens_per_sec * flops_per_token / peak
+
+    print(json.dumps({
+        "metric": "gpt125m_train_tokens_per_sec_per_chip" if on_tpu
+        else "gpt_tiny_cpu_tokens_per_sec",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu, 4),  # MFU fraction as baseline comparator
+    }))
+
+
+if __name__ == "__main__":
+    main()

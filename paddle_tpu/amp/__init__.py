@@ -1,0 +1,206 @@
+"""AMP (reference: python/paddle/amp/ — auto_cast:859, amp_lists.py,
+GradScaler grad_scaler.py:619).
+
+TPU-native: bf16 is the default low-precision dtype (hardware native, no loss
+scaling needed); fp16 + dynamic loss scaling supported for parity with the
+reference's GPU recipes."""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.state import STATE
+from ..core.tensor import Tensor
+
+# Op lists mirroring amp/amp_lists.py (white = run in low precision,
+# black = force fp32)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "einsum", "mm", "bmm", "addmm",
+    "flash_attention", "sdpa", "lstm_cell", "gru_cell", "simple_rnn_cell",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "cos_sim",
+    "softmax", "log_softmax", "cross_entropy", "bce", "bce_with_logits",
+    "nll_loss", "mse_loss", "l1_loss", "kl_div", "layer_norm", "rms_norm",
+    "batch_norm", "group_norm", "instance_norm", "p_norm", "softmax_with_cross_entropy",
+    "sigmoid_focal_loss", "cumsum", "logsumexp", "erfinv", "pow", "var", "std",
+    "renorm", "atan2", "acos", "asin", "cosh", "sinh", "tan", "logcumsumexp",
+}
+
+
+def white_list():
+    return WHITE_LIST
+
+
+def black_list():
+    return BLACK_LIST
+
+
+class auto_cast:
+    """Context manager paddle.amp.auto_cast (reference: amp/auto_cast.py:859)."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        if dtype in ("float16", "fp16"):
+            dtype = "float16"
+        else:
+            dtype = "bfloat16"
+        self.enable = enable
+        self.level = level if enable else "O0"
+        self.dtype = dtype
+        self.white = set(WHITE_LIST)
+        self.black = set(BLACK_LIST)
+        if custom_white_list:
+            self.white |= set(custom_white_list)
+            self.black -= set(custom_white_list)
+        if custom_black_list:
+            self.black |= set(custom_black_list)
+            self.white -= set(custom_black_list)
+
+    def __enter__(self):
+        self._prev = (STATE.amp_level, STATE.amp_dtype, STATE.amp_white,
+                      STATE.amp_black)
+        STATE.amp_level = self.level if self.enable else "O0"
+        STATE.amp_dtype = self.dtype
+        STATE.amp_white = self.white
+        STATE.amp_black = self.black
+        return self
+
+    def __exit__(self, *exc):
+        (STATE.amp_level, STATE.amp_dtype, STATE.amp_white,
+         STATE.amp_black) = self._prev
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2 decoration: cast model params to low precision; optimizers keep fp32
+    master weights (reference: amp/auto_cast.py decorate:943)."""
+    from ..nn.layer.norm import _NormBase, GroupNorm, LayerNorm
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        target = "float16" if dtype in ("float16", "fp16") else "bfloat16"
+        for m in model_list:
+            for lay in m.sublayers(include_self=True):
+                if isinstance(lay, (_NormBase, LayerNorm, GroupNorm)):
+                    continue
+                if excluded_layers and isinstance(lay, tuple(excluded_layers)):
+                    continue
+                for p in lay._parameters.values():
+                    if p is not None and p._data.dtype == jnp.float32:
+                        p._data = p._data.astype(
+                            jnp.float16 if target == "float16"
+                            else jnp.bfloat16)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: amp/grad_scaler.py:619).  On TPU only
+    needed for fp16; bf16 training sets enable=False."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p is not None and p.grad is not None:
+                g = p.grad._data
+                p.grad._data = (g.astype(jnp.float32) * inv).astype(g.dtype)
+                if bool(jnp.any(~jnp.isfinite(p.grad._data.astype(jnp.float32)))):
+                    found = True
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        pass  # folded into step (paddle compat: scaler.update() no-op here)
+
+    def _update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor._wrap(jnp.asarray(self._scale, jnp.float32))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+debugging = None  # placeholder namespace (reference: amp/debugging.py)

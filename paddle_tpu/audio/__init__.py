@@ -1,0 +1,3 @@
+"""Audio features (reference: python/paddle/audio/)."""
+from . import functional  # noqa: F401
+from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401

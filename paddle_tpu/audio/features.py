@@ -1,0 +1,97 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .functional import compute_fbank_matrix, create_dct, get_window, power_to_db
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer("window", get_window(window, self.win_length))
+
+    def forward(self, x):
+        def fn(v, w):
+            if self.center:
+                pad = self.n_fft // 2
+                v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
+                            mode="reflect" if self.pad_mode == "reflect"
+                            else "constant")
+            n_frames = 1 + (v.shape[-1] - self.n_fft) // self.hop
+            idx = (jnp.arange(self.n_fft)[None, :]
+                   + self.hop * jnp.arange(n_frames)[:, None])
+            frames = v[..., idx]  # [..., frames, n_fft]
+            wpad = jnp.pad(w, (0, self.n_fft - self.win_length))
+            spec = jnp.fft.rfft(frames * wpad, axis=-1)
+            mag = jnp.abs(spec) ** self.power
+            return jnp.swapaxes(mag, -1, -2)  # [..., freq, frames]
+        return apply_op("spectrogram", fn, x, self.window)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode)
+        self.register_buffer(
+            "fbank", compute_fbank_matrix(sr, n_fft, n_mels, f_min,
+                                          f_max or sr / 2, htk, norm))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        return apply_op("mel_spectrogram",
+                        lambda s, fb: jnp.einsum("mf,...ft->...mt", fb, s),
+                        spec, self.fbank)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return power_to_db(self.mel(x), self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        n_mels, f_min, f_max, htk, norm,
+                                        ref_value, amin, top_db)
+        self.register_buffer("dct", create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        lm = self.logmel(x)
+        return apply_op("mfcc",
+                        lambda s, d: jnp.einsum("dm,...mt->...dt", d.T, s),
+                        lm, self.dct)

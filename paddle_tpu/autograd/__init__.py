@@ -1,0 +1,276 @@
+"""paddle.autograd equivalent (reference: python/paddle/autograd/)."""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+
+from ..core.autograd import run_backward
+from ..core.dispatch import apply_op
+from ..core.state import STATE, enable_grad_guard, no_grad_guard
+from ..core.tensor import Tensor
+
+
+class no_grad:
+    """Context manager AND decorator (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = STATE.grad_enabled
+        STATE.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        STATE.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = STATE.grad_enabled
+        STATE.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        STATE.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with enable_grad():
+                return fn(*a, **k)
+        return wrapper
+
+
+@contextmanager
+def set_grad_enabled(mode):
+    prev = STATE.grad_enabled
+    STATE.grad_enabled = bool(mode)
+    try:
+        yield
+    finally:
+        STATE.grad_enabled = prev
+
+
+def is_grad_enabled():
+    return STATE.grad_enabled
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (reference: python/paddle/autograd/autograd.py)."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is not None and isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None):
+    """paddle.grad — returns grads of outputs w.r.t. inputs without touching
+    ``.grad`` (reference: python/paddle/base/dygraph/base.py grad)."""
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    res = run_backward(outputs, grad_outputs, retain_graph=retain_graph,
+                       accumulate_into_grad=False, inputs=inputs)
+    if not allow_unused:
+        for r, i in zip(res, inputs):
+            if r is None:
+                raise RuntimeError(
+                    f"input tensor {i.name} is unused in the graph; pass "
+                    "allow_unused=True to get None instead")
+    return res
+
+
+class PyLayerContext:
+    """ctx object handed to PyLayer.forward/backward
+    (reference: python/paddle/autograd/py_layer.py)."""
+
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace_tensors = args
+
+    def set_materialize_grads(self, value):
+        pass
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError("PyLayer is not instantiable; call .apply()")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op with user forward/backward
+    (reference: python/paddle/autograd/py_layer.py PyLayer).
+
+    TPU design note: forward runs eagerly (or traced); backward is spliced
+    into the tape as a GradNode whose vjp calls the user's backward.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.autograd import GradNode
+
+        ctx = PyLayerContext()
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = [outs] if single else list(outs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+        if STATE.grad_enabled and diff_inputs:
+            def vjp_fn(cotangents):
+                gouts = [Tensor._wrap(c) for c in cotangents]
+                with no_grad():
+                    gins = cls.backward(ctx, *gouts)
+                gins = [gins] if isinstance(gins, Tensor) else list(gins)
+                # align with diff_inputs: user returns grads for every tensor
+                # input in order; pick the diff ones
+                out = []
+                k = 0
+                for t in tensor_inputs:
+                    g = gins[k] if k < len(gins) else None
+                    k += 1
+                    if t.stop_gradient:
+                        continue
+                    out.append(None if g is None else
+                               (g._data if isinstance(g, Tensor) else g))
+                return out
+
+            node = GradNode(cls.__name__, vjp_fn, diff_inputs,
+                            [(o._data.shape, o._data.dtype) for o in outs_t])
+            for i, o in enumerate(outs_t):
+                o.stop_gradient = False
+                o._node = node
+                o._out_idx = i
+                node.set_output(i, o)
+        return outs_t[0] if single else tuple(outs_t)
+
+
+# -- functional API over pure functions (reference: autograd/autograd.py) ----
+def _functional(fn):
+    def unwrapped(*xs):
+        outs = fn(*[Tensor._wrap(x) for x in xs])
+        if isinstance(outs, (tuple, list)):
+            return tuple(o._data for o in outs)
+        return outs._data
+    return unwrapped
+
+
+def vjp(func, xs, v=None):
+    xs_list = xs if isinstance(xs, (tuple, list)) else [xs]
+    out, vjp_fn = jax.vjp(_functional(func), *[x._data for x in xs_list])
+    if v is None:
+        import jax.numpy as jnp
+        v = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(
+            jnp.ones_like(o) for o in out)
+    else:
+        v = v._data if isinstance(v, Tensor) else tuple(
+            t._data for t in v) if isinstance(v, (tuple, list)) else v
+    grads = vjp_fn(v)
+    wrap = lambda g: Tensor._wrap(g)  # noqa: E731
+    out_w = (Tensor._wrap(out) if not isinstance(out, tuple)
+             else tuple(map(wrap, out)))
+    g_w = tuple(map(wrap, grads))
+    return out_w, g_w[0] if len(g_w) == 1 and not isinstance(xs, (tuple, list)) else g_w
+
+
+def jvp(func, xs, v=None):
+    xs_list = xs if isinstance(xs, (tuple, list)) else [xs]
+    import jax.numpy as jnp
+    if v is None:
+        v = tuple(jnp.ones_like(x._data) for x in xs_list)
+    else:
+        v = tuple(t._data for t in (v if isinstance(v, (tuple, list)) else [v]))
+    out, tang = jax.jvp(_functional(func), tuple(x._data for x in xs_list), v)
+    wrap = lambda g: Tensor._wrap(g)  # noqa: E731
+    out_w = Tensor._wrap(out) if not isinstance(out, tuple) else tuple(map(wrap, out))
+    t_w = Tensor._wrap(tang) if not isinstance(tang, tuple) else tuple(map(wrap, tang))
+    return out_w, t_w
+
+
+class Jacobian:
+    def __init__(self, data):
+        self._d = data
+
+    def __getitem__(self, idx):
+        return Tensor._wrap(self._d[idx])
+
+    def __repr__(self):
+        return f"Jacobian({self._d.shape})"
+
+    @property
+    def shape(self):
+        return list(self._d.shape)
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._d)
+
+
+def jacobian(ys_fn_or_ys, xs, batch_axis=None):
+    """paddle.autograd.jacobian over a function (functional form)."""
+    if callable(ys_fn_or_ys):
+        fn = _functional(ys_fn_or_ys)
+        xs_list = xs if isinstance(xs, (tuple, list)) else [xs]
+        jac = jax.jacrev(fn, argnums=tuple(range(len(xs_list))))(
+            *[x._data for x in xs_list])
+        if len(xs_list) == 1 and not isinstance(xs, (tuple, list)):
+            return Jacobian(jac[0] if isinstance(jac, tuple) else jac)
+        return tuple(Jacobian(j) for j in jac)
+    raise TypeError("jacobian expects a callable first argument")
+
+
+def hessian(fn, xs, batch_axis=None):
+    f = _functional(fn)
+    xs_list = xs if isinstance(xs, (tuple, list)) else [xs]
+    hes = jax.hessian(f, argnums=tuple(range(len(xs_list))))(
+        *[x._data for x in xs_list])
+    if len(xs_list) == 1 and not isinstance(xs, (tuple, list)):
+        h = hes[0][0] if isinstance(hes, tuple) else hes
+        return Jacobian(h)
+    return hes
+
+
+def saved_tensors_hooks(pack_hook, unpack_hook):
+    import contextlib
+    return contextlib.nullcontext()

@@ -1,0 +1,181 @@
+"""Define-by-run autograd over JAX VJPs.
+
+TPU-native redesign of the reference's eager autograd engine
+(``GradNodeBase`` graph, /root/reference/paddle/fluid/eager/grad_node_info.h:197;
+``egr::Backward`` queue traversal, /root/reference/paddle/fluid/eager/backward.cc:105,439).
+
+Instead of per-op hand-written grad kernels, every eager op is executed through
+``jax.vjp`` which (a) runs the forward once and (b) returns a VJP closure whose
+residuals are device arrays — the exact analogue of the reference's
+``TensorWrapper`` saved-tensor mechanism but produced automatically by JAX's
+tracing.  ``backward()`` is a reverse-topological walk accumulating cotangents.
+
+Because the whole engine operates on ``jax.Array``/tracers, the *same* code
+path works under ``jax.jit``: tracing a function that calls ``loss.backward()``
+yields one fused XLA program for forward+backward (the "dy2static" story).
+"""
+
+from __future__ import annotations
+
+import weakref
+
+import jax.numpy as jnp
+
+
+class GradNode:
+    """One taped op: VJP closure + edges to parent tensors.
+
+    Mirrors ``GradNodeBase`` (grad_node_info.h:197): ``vjp_fn`` plays the role
+    of the generated ``operator()``, ``parents`` the role of
+    ``SetGradOutMeta`` edges.
+    """
+
+    __slots__ = ("name", "vjp_fn", "parents", "out_avals", "out_refs",
+                 "__weakref__")
+
+    def __init__(self, name, vjp_fn, parents, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.parents = parents            # list[Tensor] (diff inputs, order = vjp outputs)
+        self.out_avals = out_avals        # list[(shape, dtype)]
+        self.out_refs = [None] * len(out_avals)  # weakrefs to output Tensors
+
+    def set_output(self, idx, tensor):
+        self.out_refs[idx] = weakref.ref(tensor)
+
+
+def _topo_order(roots):
+    """Iterative post-order DFS over the node graph; returns topological list
+    (parents before children is NOT needed — we process reversed post-order)."""
+    order, visited, stack = [], set(), [(r, False) for r in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for p in node.parents:
+            pn = p._node
+            if pn is not None and id(pn) not in visited:
+                stack.append((pn, False))
+    return order  # post-order: parents appear before consumers
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 accumulate_into_grad=True, inputs=None):
+    """Reverse-accumulate cotangents from ``tensors``.
+
+    Reference analogue: ``egr::RunBackward`` (backward.cc:105).
+    If ``inputs`` is given (paddle.grad semantics) returns their grads as raw
+    arrays instead of (only) writing ``.grad``.
+    """
+    from .tensor import Tensor  # late import
+
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    # Cotangent accumulator keyed per node: {id(node): [grad|None per output]}
+    accum: dict[int, list] = {}
+    nodes: dict[int, GradNode] = {}
+    leaf_grads: dict[int, object] = {}   # id(tensor) -> raw grad array
+    roots = []
+
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    f"backward() on non-scalar tensor shape={t.shape} requires "
+                    "an explicit grad tensor")
+            g = jnp.ones_like(t._data)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._node
+        if node is None:
+            # leaf: grad is the cotangent itself
+            if not t.stop_gradient:
+                _leaf_accumulate(leaf_grads, t, g)
+            continue
+        slot = accum.setdefault(id(node), [None] * len(node.out_avals))
+        slot[t._out_idx] = g if slot[t._out_idx] is None else slot[t._out_idx] + g
+        nodes[id(node)] = node
+        roots.append(node)
+
+    order = _topo_order(roots)
+    # process consumers first: reversed post-order
+    for node in reversed(order):
+        slot = accum.get(id(node))
+        if slot is None:
+            continue
+        outgrads = tuple(
+            g if g is not None else jnp.zeros(shape, dtype)
+            for g, (shape, dtype) in zip(slot, node.out_avals))
+        # tensor-level hooks on this node's outputs
+        outgrads = list(outgrads)
+        for i, ref in enumerate(node.out_refs):
+            t = ref() if ref is not None else None
+            if t is not None and t._hooks:
+                for h in t._hooks:
+                    r = h(Tensor._wrap(outgrads[i]))
+                    if r is not None:
+                        outgrads[i] = r._data if isinstance(r, Tensor) else r
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"grad graph for op '{node.name}' already freed; pass "
+                "retain_graph=True to backward() to reuse it")
+        ingrads = node.vjp_fn(tuple(outgrads))
+        if not retain_graph:
+            node.vjp_fn = None
+        for parent, g in zip(node.parents, ingrads):
+            if g is None or parent.stop_gradient:
+                continue
+            pn = parent._node
+            if pn is None:
+                if parent._hooks:
+                    for h in parent._hooks:
+                        r = h(Tensor._wrap(g))
+                        if r is not None:
+                            g = r._data if isinstance(r, Tensor) else r
+                _leaf_accumulate(leaf_grads, parent, g)
+            else:
+                pslot = accum.setdefault(id(pn), [None] * len(pn.out_avals))
+                i = parent._out_idx
+                pslot[i] = g if pslot[i] is None else pslot[i] + g
+                nodes[id(pn)] = pn
+
+    # write .grad on leaves
+    results = None
+    if inputs is not None:
+        results = []
+        for t in inputs:
+            g = leaf_grads.get(id(t))
+            if g is None and t._node is not None:
+                slot = accum.get(id(t._node))
+                if slot is not None:
+                    g = slot[t._out_idx]
+            results.append(None if g is None else Tensor._wrap(g))
+    if accumulate_into_grad:
+        for t_id, g in leaf_grads.items():
+            t = _LEAF_CACHE.pop(t_id, None)
+            if t is None:
+                continue
+            if t.grad is None:
+                t.grad = Tensor._wrap(g)
+            else:
+                t.grad = Tensor._wrap(t.grad._data + g)
+    else:
+        _LEAF_CACHE.clear()
+    return results
+
+
+_LEAF_CACHE: dict[int, object] = {}
+
+
+def _leaf_accumulate(leaf_grads, t, g):
+    _LEAF_CACHE[id(t)] = t
+    if id(t) in leaf_grads:
+        leaf_grads[id(t)] = leaf_grads[id(t)] + g
+    else:
+        leaf_grads[id(t)] = g

@@ -1,0 +1,151 @@
+"""Eager op dispatch: the TPU-native ``KernelFactory``.
+
+Reference analogue: the generated ``*_ad_func`` pipeline — AMP autocast
+(eager_gen.py:565) → kernel selection (``KernelFactory::SelectKernelOrThrowError``,
+/root/reference/paddle/phi/core/kernel_factory.cc:230) → kernel launch →
+GradNode creation (eager_gen.py:1103).
+
+Here a "kernel" is a jnp/lax-traceable function; dispatch is one Python call:
+unwrap Tensors → AMP cast → execute (via ``jax.vjp`` when taping) → wrap
+outputs + build the GradNode.  Under ``jax.jit`` the same path traces into a
+single XLA program, so there is no separate static-graph dispatch tier.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .autograd import GradNode
+from .flags import flag
+from .state import STATE, grad_enabled
+
+# ---------------------------------------------------------------------------
+# Op registry (single source of truth, YAML analogue of
+# /root/reference/paddle/phi/ops/yaml/ops.yaml)
+# ---------------------------------------------------------------------------
+OPS: dict[str, dict] = {}
+
+
+def register_op(name, fn=None, **meta):
+    if name not in OPS:
+        OPS[name] = {"fn": fn, **meta}
+    return OPS[name]
+
+
+def _amp_cast(name, datas):
+    """O1/O2 autocast, mirroring amp/auto_cast.py white/black lists."""
+    level = STATE.amp_level
+    if level == "O0":
+        return datas
+    target = jnp.bfloat16 if STATE.amp_dtype == "bfloat16" else jnp.float16
+    if name in STATE.amp_white:
+        return [d.astype(target)
+                if hasattr(d, "dtype") and d.dtype in (jnp.float32, jnp.float64)
+                else d for d in datas]
+    if name in STATE.amp_black:
+        return [d.astype(jnp.float32)
+                if hasattr(d, "dtype") and d.dtype in (jnp.float16, jnp.bfloat16)
+                else d for d in datas]
+    if level == "O2":
+        # O2: everything not blacklisted runs in low precision
+        return [d.astype(target)
+                if hasattr(d, "dtype") and d.dtype in (jnp.float32,)
+                else d for d in datas]
+    return datas
+
+
+def _is_tensor(x):
+    from .tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+def apply_op(name, fn, *args, nout=1, amp=True, **kwargs):
+    """Execute op ``name`` implemented by traceable ``fn``.
+
+    ``args`` may be an arbitrary pytree containing Tensors; ``kwargs`` are
+    static attributes.  Returns Tensor or tuple of Tensors (len == nout, or
+    whatever fn returns if nout is None).
+    """
+    from .tensor import Tensor
+
+    register_op(name, fn)
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        args, is_leaf=lambda x: isinstance(x, Tensor))
+    datas = [l._data if isinstance(l, Tensor) else l for l in leaves]
+    do_amp = amp and STATE.amp_level != "O0"
+
+    diff_pos = []
+    if grad_enabled():
+        for i, l in enumerate(leaves):
+            if (isinstance(l, Tensor) and not l.stop_gradient
+                    and dtypes.is_floating(datas[i].dtype)):
+                diff_pos.append(i)
+
+    if not diff_pos:
+        if do_amp:
+            datas = _amp_cast(name, datas)
+        rebuilt = jax.tree_util.tree_unflatten(treedef, datas)
+        out = fn(*rebuilt, **kwargs)
+        return _wrap_outputs(name, out, None, nout)
+
+    def closure(*dvals):
+        ds = list(datas)
+        for p, v in zip(diff_pos, dvals):
+            ds[p] = v
+        if do_amp:
+            # cast inside the closure so cotangent dtypes match the
+            # (uncast) parent tensors — the cast's own VJP converts grads
+            ds = _amp_cast(name, ds)
+        rebuilt = jax.tree_util.tree_unflatten(treedef, ds)
+        out = fn(*rebuilt, **kwargs)
+        return out if isinstance(out, tuple) else (out,)
+
+    primals = [datas[p] for p in diff_pos]
+    outs, vjp_fn = jax.vjp(closure, *primals)
+    parents = [leaves[p] for p in diff_pos]
+    node = GradNode(name, vjp_fn, parents,
+                    [(o.shape, o.dtype) for o in outs])
+    return _wrap_outputs(name, outs if nout != 1 or len(outs) > 1 else outs[0],
+                         node, nout)
+
+
+def _wrap_outputs(name, out, node, nout):
+    from .tensor import Tensor
+
+    if flag("FLAGS_check_nan_inf"):
+        _check_nan_inf(name, out)
+
+    def wrap_one(o, idx):
+        t = Tensor._wrap(o)
+        if node is not None:
+            if dtypes.is_floating(o.dtype):
+                t.stop_gradient = False
+            t._node = node
+            t._out_idx = idx
+            node.set_output(idx, t)
+        return t
+
+    if isinstance(out, tuple):
+        return tuple(wrap_one(o, i) for i, o in enumerate(out))
+    return wrap_one(out, 0)
+
+
+def _check_nan_inf(name, out):
+    """Debug nan/inf check (FLAGS_check_nan_inf; reference:
+    paddle/fluid/eager/nan_inf_utils.cc). Eager-concrete values only."""
+    outs = out if isinstance(out, tuple) else (out,)
+    for o in outs:
+        if isinstance(o, jax.Array) and not isinstance(
+                o, jax.core.Tracer) and jnp.issubdtype(o.dtype, jnp.floating):
+            if bool(jnp.any(~jnp.isfinite(o))):
+                raise FloatingPointError(f"op '{name}' produced NaN/Inf")
+
+
+def matmul_precision():
+    p = flag("FLAGS_tpu_matmul_precision")
+    return {"default": None, "high": jax.lax.Precision.HIGH,
+            "highest": jax.lax.Precision.HIGHEST}.get(p, None)

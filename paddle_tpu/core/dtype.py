@@ -1,0 +1,102 @@
+"""Dtype system for paddle_tpu.
+
+TPU-native design: dtypes are plain ``jnp.dtype`` objects (XLA's native element
+types).  The reference keeps a parallel C++ enum (``phi::DataType``,
+/root/reference/paddle/phi/common/data_type.h) plus a software bfloat16 type
+(/root/reference/paddle/phi/common/bfloat16.h); on TPU bfloat16 is a hardware
+type and JAX/ml_dtypes already provide it, so this module only supplies naming,
+aliasing and the binary type-promotion table
+(cf. /root/reference/paddle/phi/common/type_promotion.h).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Public dtype aliases (paddle.float32 etc.)
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+# fp8 (TPU v5+ native)
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_NAME_TO_DTYPE = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn, "float8_e5m2": float8_e5m2,
+}
+
+
+def convert_dtype(dtype):
+    """Normalise a user-supplied dtype (string / np / jnp) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _NAME_TO_DTYPE:
+            raise ValueError(f"unknown dtype string: {dtype!r}")
+        return np.dtype(_NAME_TO_DTYPE[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.integer)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.complexfloating)
+
+
+# ---------------------------------------------------------------------------
+# Type promotion (mirrors the semantics of phi/common/type_promotion.h:
+# float wins over int, wider float wins, fp16+bf16 -> float32).
+# ---------------------------------------------------------------------------
+_FLOAT_ORDER = [jnp.dtype(float16), jnp.dtype(bfloat16), jnp.dtype(float32),
+                jnp.dtype(float64)]
+
+
+def promote_types(a, b):
+    a, b = np.dtype(a), np.dtype(b)
+    if a == b:
+        return a
+    # fp16 x bf16 promotes to fp32 (no ordering between them)
+    halves = {np.dtype(np.float16), np.dtype(bfloat16)}
+    if a in halves and b in halves:
+        return np.dtype(np.float32)
+    return np.promote_types(a, b) if not (a in halves or b in halves) else (
+        _promote_with_half(a, b))
+
+
+def _promote_with_half(a, b):
+    half = a if a in {np.dtype(np.float16), np.dtype(bfloat16)} else b
+    other = b if half is a else a
+    if is_floating(other):
+        # wider float wins
+        if np.dtype(other).itemsize > 2:
+            return np.dtype(other)
+        return half
+    # int/bool + half -> half
+    return half

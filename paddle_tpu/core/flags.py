@@ -1,0 +1,68 @@
+"""Global runtime flag registry.
+
+TPU-native analogue of the reference's gflags-based registry
+(/root/reference/paddle/common/flags.cc — 159 ``PHI_DEFINE_EXPORTED_*`` flags,
+surfaced to Python via ``paddle.set_flags/get_flags``,
+/root/reference/python/paddle/base/framework.py:106,131).  Here flags are a
+process-local dict, seedable from ``FLAGS_*`` environment variables, consulted
+by the runtime (nan/inf checks, deterministic mode, log level, ...).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_REGISTRY: dict[str, dict[str, Any]] = {}
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    env = os.environ.get(name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes", "on")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _REGISTRY[name] = {"value": value, "default": default, "help": help_str}
+    return value
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if k not in _REGISTRY:
+            raise KeyError(f"unknown flag {k!r}; known: {sorted(_REGISTRY)}")
+        _REGISTRY[k]["value"] = v
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {n: _REGISTRY[n]["value"] for n in names}
+
+
+def flag(name: str):
+    return _REGISTRY[name]["value"]
+
+
+# Core runtime flags (subset of flags.cc that is meaningful on TPU).
+define_flag("FLAGS_check_nan_inf", False,
+            "Check every op output for NaN/Inf (debug; forces sync).")
+define_flag("FLAGS_cudnn_deterministic", False,
+            "Deterministic mode (maps to XLA deterministic ops).")
+define_flag("FLAGS_embedding_deterministic", 0, "compat alias")
+define_flag("FLAGS_use_stride_kernel", True, "views share memory when possible")
+define_flag("FLAGS_low_precision_op_list", 0, "log amp op decisions")
+define_flag("FLAGS_benchmark", False, "sync after every op for timing")
+define_flag("FLAGS_log_level", 0, "verbose log level (VLOG equivalent)")
+define_flag("FLAGS_allocator_strategy", "xla",
+            "memory strategy: XLA owns device memory on TPU")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "compat no-op on TPU")
+define_flag("FLAGS_tpu_matmul_precision", "default",
+            "default|high|highest -> jax.lax precision for matmul ops")
+define_flag("FLAGS_eager_op_jit", False,
+            "route eager op execution through a per-op jit cache")

@@ -1,0 +1,49 @@
+"""Thread-local interpreter state: grad mode and AMP mode.
+
+Reference analogue: ``egr::Controller`` (AMP level consulted by every generated
+ad_func, /root/reference/paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:565)
+and the ``no_grad`` tracer guard.  On TPU these are host-side Python state that
+steer tracing — they cost nothing inside compiled programs.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.amp_level = "O0"          # O0 | O1 | O2
+        self.amp_dtype = "bfloat16"    # TPU-native default (fp16 supported)
+        self.amp_white = set()
+        self.amp_black = set()
+        self.tracing_depth = 0         # >0 while inside jax.jit trace
+
+
+STATE = _State()
+
+
+@contextmanager
+def no_grad_guard():
+    prev = STATE.grad_enabled
+    STATE.grad_enabled = False
+    try:
+        yield
+    finally:
+        STATE.grad_enabled = prev
+
+
+@contextmanager
+def enable_grad_guard():
+    prev = STATE.grad_enabled
+    STATE.grad_enabled = True
+    try:
+        yield
+    finally:
+        STATE.grad_enabled = prev
+
+
+def grad_enabled() -> bool:
+    return STATE.grad_enabled

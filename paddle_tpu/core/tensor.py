@@ -1,0 +1,388 @@
+"""The eager Tensor.
+
+TPU-native redesign of ``phi::DenseTensor`` + ``paddle::Tensor``
+(/root/reference/paddle/phi/core/dense_tensor.h:27,
+/root/reference/paddle/phi/api/include/tensor.h:82) and the Python-side
+``paddle.Tensor`` patched methods
+(/root/reference/python/paddle/base/dygraph/tensor_patch_methods.py).
+
+A Tensor wraps a ``jax.Array`` (device buffer owned by the XLA runtime — there
+is no user-level allocator on TPU; cf. SURVEY §2.2 note) plus autograd
+metadata (``stop_gradient``, ``grad``, GradNode edge) — the analogue of
+``AutogradMeta`` (/root/reference/paddle/fluid/eager/autograd_meta.h:61).
+
+The same object works inside ``jax.jit`` traces: ``_data`` is then a tracer
+and all ops stay traceable, which is how whole-program capture (paddle.jit)
+works without a second IR.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .autograd import run_backward
+from .dispatch import apply_op
+from .state import no_grad_guard
+
+_tensor_counter = [0]
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx",
+                 "name", "persistable", "_hooks", "trainable", "is_dist",
+                 "placements", "process_mesh", "__weakref__", "__dict__")
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        dt = dtypes.convert_dtype(dtype)
+        if isinstance(data, (jax.Array, jax.core.Tracer)):
+            self._data = data if dt is None else data.astype(dt)
+        else:
+            arr = np.asarray(data)
+            # paddle default: python float data -> float32, int -> int64
+            if dt is None and arr.dtype == np.float64:
+                dt = np.dtype(np.float32)
+            self._data = jnp.asarray(arr, dtype=dt)
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self.persistable = False
+        self.trainable = True
+        self._hooks = []
+        self.is_dist = False
+        self.placements = None
+        self.process_mesh = None
+        if name is None:
+            _tensor_counter[0] += 1
+            name = f"generated_tensor_{_tensor_counter[0]}"
+        self.name = name
+
+    # -- construction helpers ------------------------------------------------
+    @staticmethod
+    def _wrap(data, stop_gradient=True):
+        t = Tensor.__new__(Tensor)
+        t._data = data
+        t.stop_gradient = stop_gradient
+        t.grad = None
+        t._node = None
+        t._out_idx = 0
+        t.persistable = False
+        t.trainable = True
+        t._hooks = []
+        t.is_dist = False
+        t.placements = None
+        t.process_mesh = None
+        _tensor_counter[0] += 1
+        t.name = f"generated_tensor_{_tensor_counter[0]}"
+        return t
+
+    # -- basic properties ----------------------------------------------------
+    @property
+    def data(self):
+        return self
+
+    @data.setter
+    def data(self, value):
+        self._data = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        from ..device import _current_place
+        try:
+            devs = self._data.devices()
+            d = next(iter(devs))
+            return f"{d.platform}:{d.id}"
+        except Exception:
+            return _current_place()
+
+    @property
+    def T(self):
+        return apply_op("transpose", lambda x: x.T, self)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return Tensor._wrap(jnp.asarray(self.size, dtype=jnp.int64))
+
+    # -- conversion ----------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *idx):
+        a = np.asarray(self._data)
+        return a.item(*idx) if idx else a.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def astype(self, dtype):
+        dt = dtypes.convert_dtype(dtype)
+        return apply_op("cast", lambda x: x.astype(dt), self)
+
+    cast = astype
+
+    def clone(self):
+        return apply_op("assign", lambda x: x + 0 if False else jnp.copy(x), self)
+
+    def detach(self):
+        t = Tensor._wrap(self._data)
+        t.stop_gradient = True
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def cpu(self):
+        return Tensor._wrap(jax.device_put(self._data, jax.devices("cpu")[0])
+                            if jax.devices()[0].platform != "cpu" else self._data)
+
+    def to(self, *args, **kwargs):
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu") or ":" in str(a):
+                continue
+            dtype = a
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    cuda = to  # compat: .cuda() is a no-op move on TPU
+    tpu = to
+
+    def pin_memory(self):
+        return self
+
+    # -- autograd ------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(h):
+                if hook in self._hooks:
+                    self._hooks.remove(hook)
+        return _Handle()
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor._wrap(jnp.zeros_like(self.grad._data))
+        else:
+            self.grad = None
+
+    clear_grad = clear_gradient
+    zero_ = clear_gradient
+
+    @property
+    def is_tensor(self):
+        return True
+
+    def _inplace_assign(self, out):
+        """Rebind this tensor to ``out``'s value+node (functional in-place)."""
+        self._data = out._data
+        self._node = out._node
+        self._out_idx = out._out_idx
+        self.stop_gradient = out.stop_gradient
+        if self._node is not None:
+            self._node.set_output(self._out_idx, self)
+        return self
+
+    # -- operators -----------------------------------------------------------
+    def _b(self, name, fn, other, reverse=False):
+        if isinstance(other, (int, float, bool, complex, np.number)):
+            a, b = (other, self) if reverse else (self, other)
+            return apply_op(name, fn, a, b)
+        other = other if isinstance(other, Tensor) else Tensor(other)
+        a, b = (other, self) if reverse else (self, other)
+        return apply_op(name, fn, a, b)
+
+    def __add__(self, o):
+        return self._b("add", jnp.add, o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._b("subtract", jnp.subtract, o)
+
+    def __rsub__(self, o):
+        return self._b("subtract", jnp.subtract, o, reverse=True)
+
+    def __mul__(self, o):
+        return self._b("multiply", jnp.multiply, o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._b("divide", jnp.true_divide, o)
+
+    def __rtruediv__(self, o):
+        return self._b("divide", jnp.true_divide, o, reverse=True)
+
+    def __floordiv__(self, o):
+        return self._b("floor_divide", jnp.floor_divide, o)
+
+    def __mod__(self, o):
+        return self._b("remainder", jnp.remainder, o)
+
+    def __pow__(self, o):
+        return self._b("pow", jnp.power, o)
+
+    def __rpow__(self, o):
+        return self._b("pow", jnp.power, o, reverse=True)
+
+    def __matmul__(self, o):
+        from .dispatch import matmul_precision
+        return self._b("matmul",
+                       lambda a, b: jnp.matmul(a, b,
+                                               precision=matmul_precision()),
+                       o)
+
+    def __neg__(self):
+        return apply_op("scale", jnp.negative, self)
+
+    def __abs__(self):
+        return apply_op("abs", jnp.abs, self)
+
+    def __invert__(self):
+        return apply_op("bitwise_not", jnp.invert, self)
+
+    def _cmp(self, name, fn, o):
+        o = o._data if isinstance(o, Tensor) else o
+        return Tensor._wrap(fn(self._data, o))
+
+    def __lt__(self, o):
+        return self._cmp("less_than", jnp.less, o)
+
+    def __le__(self, o):
+        return self._cmp("less_equal", jnp.less_equal, o)
+
+    def __gt__(self, o):
+        return self._cmp("greater_than", jnp.greater, o)
+
+    def __ge__(self, o):
+        return self._cmp("greater_equal", jnp.greater_equal, o)
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._cmp("equal", jnp.equal, o)
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._cmp("not_equal", jnp.not_equal, o)
+
+    def __hash__(self):
+        return id(self)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- indexing ------------------------------------------------------------
+    @staticmethod
+    def _unwrap_index(idx):
+        if isinstance(idx, Tensor):
+            return idx._data
+        if isinstance(idx, tuple):
+            return tuple(Tensor._unwrap_index(i) for i in idx)
+        if isinstance(idx, list):
+            return jnp.asarray(idx)
+        return idx
+
+    def __getitem__(self, idx):
+        idx = Tensor._unwrap_index(idx)
+        return apply_op("slice", lambda x: x[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = Tensor._unwrap_index(idx)
+        value = value if isinstance(value, Tensor) else Tensor(value)
+        out = apply_op("set_value",
+                       lambda x, v: x.at[idx].set(v.astype(x.dtype)), self,
+                       value)
+        self._inplace_assign(out)
+
+    # -- repr ----------------------------------------------------------------
+    def __repr__(self):
+        if isinstance(self._data, jax.core.Tracer):
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+                    f"<traced>)")
+        return (f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, "
+                f"stop_gradient={self.stop_gradient},\n"
+                f"       {np.asarray(self._data)!r})")
+
+    __str__ = __repr__
+
+    # numpy priority so np scalar * Tensor routes here
+    __array_priority__ = 100
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor (reference: python/paddle/tensor/creation.py)."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: EagerParamBase,
+    python/paddle/base/framework.py)."""
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+    def set_value(self, value):
+        value = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        with no_grad_guard():
+            self._data = value.astype(self._data.dtype)
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()

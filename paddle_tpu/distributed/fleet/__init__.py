@@ -1,0 +1,123 @@
+"""Fleet — hybrid-parallel user API (reference:
+python/paddle/distributed/fleet/fleet.py — init:167,
+distributed_optimizer:1326; meta_parallel/ wrappers).
+
+TPU-native: ``fleet.init`` builds the global hybrid Mesh (pp/dp/sharding/
+sep/mp); ``distributed_model`` annotates model parameters with
+PartitionSpecs per strategy; ``distributed_optimizer`` wraps the optimizer
+with hybrid grad-clip semantics.  The heavy lifting (collectives, overlap,
+bucketing) happens inside the compiled train step via GSPMD."""
+
+from __future__ import annotations
+
+from ...core.tensor import Tensor
+from ..env import build_mesh, get_mesh, get_rank, get_world_size, hybrid_degrees
+from .distributed_strategy import DistributedStrategy
+from .topology import CommunicateTopology, HybridCommunicateGroup
+from . import mp_layers as meta_parallel_mp  # noqa: F401
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
+                        RowParallelLinear, VocabParallelEmbedding,
+                        get_rng_state_tracker)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+
+_FLEET = {"initialized": False, "strategy": None, "hcg": None}
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    """reference: fleet/fleet.py:167."""
+    if strategy is None:
+        strategy = DistributedStrategy()
+    hc = strategy.hybrid_configs
+    degrees = {
+        "dp": hc.get("dp_degree", 1),
+        "mp": hc.get("mp_degree", 1),
+        "pp": hc.get("pp_degree", 1),
+        "sharding": hc.get("sharding_degree", 1),
+        "sep": hc.get("sep_degree", 1),
+    }
+    import jax
+    n = jax.device_count()
+    specified = 1
+    for v in degrees.values():
+        specified *= max(v, 1)
+    if specified == 1 and n > 1:
+        degrees["dp"] = n
+    build_mesh(degrees)
+    _FLEET["initialized"] = True
+    _FLEET["strategy"] = strategy
+    _FLEET["hcg"] = HybridCommunicateGroup(topology=CommunicateTopology(
+        hybrid_group_names=["pp", "dp", "sharding", "sep", "mp"],
+        dims=[degrees["pp"], degrees["dp"], degrees["sharding"],
+              degrees["sep"], degrees["mp"]]))
+    return _FLEET["hcg"]
+
+
+def get_hybrid_communicate_group():
+    return _FLEET["hcg"]
+
+
+def is_initialized():
+    return _FLEET["initialized"]
+
+
+def worker_num():
+    return get_world_size()
+
+
+def worker_index():
+    return get_rank()
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def barrier_worker():
+    from ..communication import barrier
+    barrier()
+
+
+def distributed_model(model):
+    """reference: fleet/model.py:32 — picks the wrapper by strategy.
+
+    Here: annotates parameters with their PartitionSpecs (TP layers already
+    self-annotate) and returns the model (optionally wrapped for PP)."""
+    from .parallel_apply import apply_fsdp_annotations
+    strategy = _FLEET["strategy"] or DistributedStrategy()
+    deg = hybrid_degrees()
+    if deg.get("sharding", 1) > 1:
+        apply_fsdp_annotations(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """reference: fleet/fleet.py:1326 → HybridParallelOptimizer."""
+    from .hybrid_optimizer import HybridParallelOptimizer
+    return HybridParallelOptimizer(optimizer,
+                                   _FLEET["hcg"],
+                                   _FLEET["strategy"] or DistributedStrategy())
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *args, **kwargs):
+        pass
+
+
+class PaddleCloudRoleMaker:
+    """reference: fleet/base/role_maker.py."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+
+    def worker_num(self):
+        return get_world_size()
+
+    def worker_index(self):
+        return get_rank()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False

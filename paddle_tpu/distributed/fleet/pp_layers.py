@@ -1,0 +1,174 @@
+"""Pipeline-parallel layer partitioning (reference:
+fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc:56,
+PipelineLayer:257, SegmentLayers:92).
+
+TPU-native: PipelineLayer keeps the LayerDesc description; the compiled
+pipeline engine (paddle_tpu/distributed/pipeline.py) stacks homogeneous stage
+blocks along a leading 'pp'-sharded axis and runs the 1F1B-equivalent
+collective-permute schedule inside ONE jitted program (SURVEY §7 hard part 1,
+option (b) — the high-MFU design)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer.layers import Layer, LayerList, Sequential
+
+
+class LayerDesc:
+    """reference: pp_layers.py:56."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """reference: pp_layers.py:76 — layers shared across stages (e.g. tied
+    embeddings)."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """reference: pp_layers.py:92 — uniform or boundary-class segmentation."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self):
+        n = len(self.descs)
+        if self.method == "uniform":
+            return self.uniform(n, self.num_parts)
+        if self.method.startswith("layer:"):
+            cls_name = self.method.split(":", 1)[1]
+            marks = [i for i, d in enumerate(self.descs)
+                     if self._name_of(d) == cls_name]
+            if len(marks) % self.num_parts != 0:
+                raise ValueError(
+                    f"{len(marks)} '{cls_name}' layers not divisible into "
+                    f"{self.num_parts} stages")
+            per = len(marks) // self.num_parts
+            bounds = [0]
+            for p in range(1, self.num_parts):
+                bounds.append(marks[p * per])
+            bounds.append(n)
+            return bounds
+        raise ValueError(self.method)
+
+    @staticmethod
+    def _name_of(desc):
+        if isinstance(desc, LayerDesc):
+            return desc.layer_func.__name__
+        return type(desc).__name__
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        base = num_items // num_parts
+        extra = num_items % num_parts
+        bounds = [0]
+        for i in range(num_parts):
+            bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+        return bounds
+
+
+class PipelineLayer(Layer):
+    """reference: pp_layers.py:257.
+
+    Single-process TPU semantics: builds ALL stages (the mesh shards them at
+    compile time), records the stage partition, and runs sequentially in
+    eager mode.  The compiled pipeline engine consumes ``get_stage_layers``.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self.descs = list(layers)
+        from ..env import hybrid_degrees
+        self.num_stages = num_stages or max(hybrid_degrees().get("pp", 1), 1)
+        self.seg_method = seg_method
+        self._recompute_interval = recompute_interval
+        seg = SegmentLayers(self.descs, self.num_stages, seg_method)
+        self.segment_bounds = seg.do_segment()
+        built = []
+        self._shared = {}
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    built.append(("shared", d.layer_name, d))
+                    continue
+                layer = d.build_layer()
+                self._shared[d.layer_name] = layer
+                built.append(("shared_first", d.layer_name, d, layer))
+            elif isinstance(d, LayerDesc):
+                built.append(("layer", d.build_layer()))
+            elif isinstance(d, Layer):
+                built.append(("layer", d))
+            elif callable(d):
+                built.append(("fn", d))
+            else:
+                raise TypeError(f"bad pipeline item {d}")
+        self._items = built
+        run_layers = []
+        for item in built:
+            if item[0] == "layer":
+                run_layers.append(item[1])
+            elif item[0] == "shared_first":
+                run_layers.append(item[3])
+        self.run_functions = LayerList(run_layers)
+        # rebuild ordered executable list (mix of layers and fns)
+        self._exec = []
+        li = 0
+        for item in built:
+            if item[0] == "layer":
+                self._exec.append(self.run_functions[li])
+                li += 1
+            elif item[0] == "shared_first":
+                self._exec.append(self.run_functions[li])
+                li += 1
+            elif item[0] == "shared":
+                shared = self._shared[item[1]]
+                fwd = item[2].forward_func
+                if fwd is not None:
+                    self._exec.append(lambda x, _l=shared, _f=fwd: _f(_l, x))
+                else:
+                    self._exec.append(shared)
+            else:
+                self._exec.append(item[1])
+
+    def get_stage_layers(self, stage_id):
+        lo, hi = self.segment_bounds[stage_id], self.segment_bounds[stage_id + 1]
+        return self._exec[lo:hi]
+
+    def forward(self, x):
+        from .recompute import recompute
+        for i, f in enumerate(self._exec):
+            if self._recompute_interval > 0 and \
+                    i % self._recompute_interval == 0 and self.training:
+                x = recompute(f, x)
+            else:
+                x = f(x)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            raise RuntimeError("no loss_fn configured")
+        return self._loss_fn(output, label)

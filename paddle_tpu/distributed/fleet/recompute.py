@@ -1,0 +1,88 @@
+"""Recompute / activation checkpointing (reference:
+fleet/recompute/recompute.py:109 RecomputeFunction — PyLayer that re-runs
+forward under saved RNG state during backward).
+
+TPU-native: ``jax.checkpoint`` (remat) does exactly this inside the compiled
+program — and composes with the tape: we run the forward through jax.vjp of a
+rematerialized function, so residuals are dropped and recomputed in backward.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+
+
+def recompute(function, *args, **kwargs):
+    """reference: recompute.py recompute:403."""
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+
+    layer = function if isinstance(function, Layer) else None
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other_args = args
+
+    if layer is not None:
+        params = list(layer.parameters())
+    else:
+        params = []
+    diff_params = [p for p in params if not p.stop_gradient]
+
+    def raw_fn(arg_datas, param_datas):
+        # bind params
+        for p, d in zip(diff_params, param_datas):
+            p._data = d
+        wrapped = [Tensor._wrap(d) if isinstance(
+            d, (jax.Array, jax.core.Tracer)) else d for d in arg_datas]
+        it = iter(wrapped)
+        full_args = [next(it) if isinstance(a, Tensor) else a for a in args]
+        from ...core.state import no_grad_guard
+        with no_grad_guard():  # outer jax.vjp differentiates; skip inner tape
+            out = function(*full_args, **kwargs)
+        if isinstance(out, tuple):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    ckpt_fn = jax.checkpoint(raw_fn)
+
+    def op_fn(*flat):
+        n = len(tensor_args)
+        arg_datas = flat[:n]
+        param_datas = flat[n:]
+        saved = [p._data for p in diff_params]
+        try:
+            return ckpt_fn(list(arg_datas), list(param_datas))
+        finally:
+            for p, s in zip(diff_params, saved):
+                p._data = s
+
+    return apply_op("recompute", op_fn, *tensor_args, *diff_params)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference: recompute.py recompute_sequential:567 — checkpoint a
+    Sequential in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    if isinstance(functions, Layer):
+        layers = list(functions.children()) or [functions]
+    else:
+        layers = list(functions)
+    import numpy as np
+    bounds = np.linspace(0, len(layers), segments + 1).astype(int)
+    out = args[0] if len(args) == 1 else args
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        seg_layers = layers[lo:hi]
+
+        def seg_fn(x, _layers=seg_layers):
+            for l in _layers:
+                x = l(x)
+            return x
+        out = recompute(seg_fn, out, **kwargs)
+    return out
+
+
+class RecomputeFunction:
+    apply = staticmethod(recompute)

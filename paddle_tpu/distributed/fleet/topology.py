@@ -1,0 +1,158 @@
+"""Rank topology (reference: fleet/base/topology.py —
+CommunicateTopology:65, HybridCommunicateGroup:178, axis order at :290).
+
+On TPU ranks-in-axes are mesh coordinates; groups are views over the mesh.
+Kept for API parity: model code asks the HCG for per-axis ranks/groups."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..communication import Group
+from ..env import get_mesh, get_rank, get_world_size, hybrid_degrees
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("pp", "dp", "sharding", "sep",
+                                           "mp"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world = int(np.prod(self._dims))
+        self._coords = {}
+        ranks = np.arange(self._world).reshape(self._dims)
+        it = np.nditer(ranks, flags=["multi_index"])
+        while not it.finished:
+            self._coords[int(it[0])] = tuple(it.multi_index)
+            it.iternext()
+        self._ranks = ranks
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return int(self._ranks[coord])
+
+    def get_coord(self, rank):
+        return self._coords[rank]
+
+    def get_axis_list(self, axis_name, index):
+        ax = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[ax] = index
+        return [int(r) for r in self._ranks[tuple(sl)].reshape(-1)]
+
+    def get_comm_list(self, axis_name):
+        """All groups along axis_name: list of rank lists."""
+        ax = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._ranks, ax, -1)
+        return [list(map(int, row)) for row in moved.reshape(-1,
+                                                             self._dims[ax])]
+
+
+class HybridCommunicateGroup:
+    """reference: topology.py:178."""
+
+    def __init__(self, topology=None):
+        deg = hybrid_degrees()
+        if topology is None:
+            topology = CommunicateTopology(
+                ["pp", "dp", "sharding", "sep", "mp"],
+                [deg["pp"], deg["dp"], deg["sharding"], deg["sep"],
+                 deg["mp"]])
+        self._topo = topology
+        self.global_rank = get_rank() % max(topology.world_size(), 1)
+        self._coord = (topology.get_coord(self.global_rank)
+                       if topology.world_size() > 0 else (0,) * 5)
+
+    # -- degrees -------------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._topo.get_dim("dp")
+
+    def get_model_parallel_world_size(self):
+        return self._topo.get_dim("mp")
+
+    def get_pipe_parallel_world_size(self):
+        return self._topo.get_dim("pp")
+
+    def get_sharding_parallel_world_size(self):
+        return self._topo.get_dim("sharding")
+
+    def get_sep_parallel_world_size(self):
+        return self._topo.get_dim("sep")
+
+    # -- my ranks ------------------------------------------------------------
+    def _axis_rank(self, name):
+        return self._coord[self._topo.get_hybrid_group_names().index(name)]
+
+    def get_data_parallel_rank(self):
+        return self._axis_rank("dp")
+
+    def get_model_parallel_rank(self):
+        return self._axis_rank("mp")
+
+    def get_stage_id(self):
+        return self._axis_rank("pp")
+
+    def get_sharding_parallel_rank(self):
+        return self._axis_rank("sharding")
+
+    def get_sep_parallel_rank(self):
+        return self._axis_rank("sep")
+
+    # -- groups (mesh-axis views) -------------------------------------------
+    def _axis_group(self, name):
+        idx = [self._coord[i] for i, n in enumerate(
+            self._topo.get_hybrid_group_names()) if n != name]
+        ax = self._topo.get_hybrid_group_names().index(name)
+        sl = list(self._coord)
+        sl[ax] = slice(None)
+        ranks = [int(r) for r in
+                 self._topo._ranks[tuple(sl)].reshape(-1)]
+        return Group(rank=self._axis_rank(name), ranks=ranks,
+                     axis_names=(name,))
+
+    def get_data_parallel_group(self):
+        return self._axis_group("dp")
+
+    def get_model_parallel_group(self):
+        return self._axis_group("mp")
+
+    def get_pipe_parallel_group(self):
+        return self._axis_group("pp")
+
+    def get_sharding_parallel_group(self):
+        return self._axis_group("sharding")
+
+    def get_sep_parallel_group(self):
+        return self._axis_group("sep")
+
+    def get_check_parallel_group(self, *a):
+        return Group(rank=0, ranks=[self.global_rank])
+
+    def get_data_parallel_group_src_rank(self):
+        return self.get_data_parallel_group().ranks[0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self.get_model_parallel_group().ranks[0]
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self.get_pipe_parallel_world_size() - 1
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self):
+        return self._topo

@@ -1,0 +1,10 @@
+"""Launcher (reference: python/paddle/distributed/launch/ — fleetrun
+console script setup.py:1907, CollectiveController spawning per-rank
+processes with PADDLE_TRAINER_* env).
+
+TPU-native: on a TPU pod each host runs ONE process that owns all local
+chips (JAX multi-controller), so the launcher spawns one process per *host*
+(or per virtual process for CPU testing) and wires the JAX coordination
+service env."""
+
+from . import main  # noqa: F401

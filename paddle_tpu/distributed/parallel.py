@@ -1,0 +1,63 @@
+"""DataParallel + parallel env entry (reference:
+python/paddle/distributed/parallel.py — DataParallel:202 with EagerReducer
+bucketed allreduce).
+
+TPU-native: under the compiled train step the batch axis is sharded over the
+'dp' mesh axis and GSPMD inserts the gradient all-reduce (fused and
+overlapped by XLA's scheduler — the Reducer's job).  Eagerly, DataParallel
+registers grad hooks that psum across processes."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .communication import ReduceOp, all_reduce
+from .env import (ParallelEnv, get_rank, get_world_size,  # noqa: F401
+                  init_parallel_env)
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._world = get_world_size() if group is None else group.nranks
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        """All-reduce grads across data-parallel ranks (reference:
+        fused_allreduce_gradients, fleet/utils/hybrid_parallel_util.py:241)."""
+        if self._world <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.SUM, group=self.group)
+                p.grad._data = p.grad._data / self._world
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+
+def fused_allreduce_gradients(params, hcg=None):
+    """reference: fleet/utils/hybrid_parallel_util.py fused_allreduce_gradients."""
+    world = get_world_size()
+    if world <= 1:
+        return
+    for p in params:
+        if p.grad is not None:
+            all_reduce(p.grad, op=ReduceOp.SUM)
+            p.grad._data = p.grad._data / world

@@ -1,0 +1,99 @@
+"""Compiled pipeline parallelism — collective-permute microbatch schedule.
+
+Reference analogue: PipelineParallel.forward_backward_pipeline
+(fleet/meta_parallel/pipeline_parallel.py:459 — host-driven 1F1B with NCCL
+send/recv per microbatch) and the static zero-bubble schedules
+(distributed/passes/pipeline_scheduler_pass/).
+
+TPU-native design (SURVEY §7 hard-part 1, option (b)): the ENTIRE schedule is
+one compiled program.  Stage weights are stacked on a leading axis sharded
+over the 'pp' mesh axis; microbatches stream through a lax.scan whose carry
+rotates between neighbor stages via lax.ppermute (ICI neighbor exchange —
+the P2P send/recv of the reference).  Only 'pp' is manual (jax.shard_map
+axis_names={'pp'}); dp/mp/sharding stay in GSPMD "auto" mode, so TP layers
+inside the stage body keep their compiler-inserted collectives.
+
+Backward is jax.grad through the scan: ppermute transposes to the reverse
+permute, giving the symmetric reverse schedule (GPipe-equivalent bubble
+2(P-1); combine with jax.checkpoint on the stage body for 1F1B-like
+activation memory)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .env import get_mesh
+
+
+def stack_spec(spec):
+    """PartitionSpec for a [num_stages, ...] stacked param: dim0 on 'pp'."""
+    return P("pp", *spec)
+
+
+def pipeline_apply(stage_fn, stage_params, x, num_microbatches, mesh=None,
+                   remat=True):
+    """Run `stage_fn(params_slice, h) -> h` as a P-stage pipeline.
+
+    stage_params: pytree with leaves stacked [P, ...] (dim0 sharded on 'pp')
+    x:            [B, ...] input activations for stage 0 (replicated on 'pp')
+    returns:      [B, ...] outputs of the last stage (replicated on 'pp')
+    """
+    mesh = mesh or get_mesh()
+    pp = mesh.shape["pp"]
+    if pp == 1:
+        params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        return stage_fn(params, x)
+    from ..core.state import STATE
+    if STATE.tracing_depth == 0:
+        # eager (uncompiled): run stages sequentially — partial-manual
+        # shard_map only exists under jit; semantics are identical
+        h = x
+        for s in range(pp):
+            params = jax.tree_util.tree_map(lambda a, _s=s: a[_s],
+                                            stage_params)
+            h = stage_fn(params, h)
+        return h
+    M = num_microbatches
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def inner(sp, xx):
+        p = jax.lax.axis_index("pp")
+        sp = jax.tree_util.tree_map(lambda a: a[0], sp)
+        b = xx.shape[0]
+        mb = b // M
+        mbs = xx.reshape(M, mb, *xx.shape[1:])
+        state0 = jnp.zeros_like(mbs[0])
+        out0 = jnp.zeros_like(mbs)
+
+        def step(carry, t):
+            state, out = carry
+            inp = jnp.where(p == 0, mbs[jnp.clip(t, 0, M - 1)], state)
+            y = body(sp, inp)
+            oidx = t - (pp - 1)
+            is_out = (p == pp - 1) & (oidx >= 0)
+            oclip = jnp.clip(oidx, 0, M - 1)
+            out = out.at[oclip].set(jnp.where(is_out, y, out[oclip]))
+            state = jax.lax.ppermute(
+                y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+            return (state, out), None
+
+        (state, out), _ = jax.lax.scan(step, (state0, out0),
+                                       jnp.arange(M + pp - 1))
+        # outputs only live on the last stage; replicate via psum
+        out = jax.lax.psum(out, "pp")
+        return out.reshape(xx.shape)
+
+    in_param_specs = jax.tree_util.tree_map(lambda a: P("pp"), stage_params)
+    sm = jax.shard_map(inner, mesh=mesh,
+                       in_specs=(in_param_specs, P()),
+                       out_specs=P(), axis_names={"pp"}, check_vma=False)
+    return sm(stage_params, x)
+
+
+def num_stages(mesh=None):
+    mesh = mesh or get_mesh()
+    return mesh.shape["pp"] if mesh is not None else 1

@@ -1,0 +1,31 @@
+"""Group-sharded (ZeRO) user API (reference:
+python/paddle/distributed/sharding/group_sharded.py group_sharded_parallel —
+stage 1/2/3 wrappers GroupShardedOptimizerStage2/Stage2/Stage3).
+
+TPU-native: stages are sharding *specs*, not runtime wrappers —
+see fleet/parallel_apply.apply_fsdp_annotations.  This module keeps the API:
+it annotates the model/optimizer and returns them."""
+
+from __future__ import annotations
+
+from ..fleet.parallel_apply import apply_fsdp_annotations
+
+
+def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """level: 'os' = stage1, 'os_g' = stage2, 'p_g_os' = stage3."""
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
+    apply_fsdp_annotations(model, stage=stage)
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from ...framework import save
+    save(model.state_dict(), output + ".pdmodel")
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

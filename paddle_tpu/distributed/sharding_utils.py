@@ -1,0 +1,55 @@
+"""Sharding annotation plumbing shared by TP/FSDP/SP layers.
+
+The reference attaches dist attrs to tensors and runs SPMD rules per op
+(phi/infermeta/spmd_rules/); on TPU GSPMD does propagation natively — layers
+only (a) record a PartitionSpec on their weights and (b) drop
+``with_sharding_constraint`` hints on activations inside traced code."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .env import get_mesh
+
+
+def annotate_param(param, spec):
+    """Attach a PartitionSpec to a parameter (consumed by the compiled train
+    step's in_shardings, and applied immediately if a mesh is live)."""
+    param.placements = spec
+    mesh = get_mesh()
+    if mesh is not None and not isinstance(param._data, jax.core.Tracer):
+        try:
+            param._data = jax.device_put(param._data,
+                                         NamedSharding(mesh, spec))
+        except Exception:
+            pass
+    return param
+
+
+def shard_constraint(x, spec):
+    """with_sharding_constraint on a Tensor inside traced code; no-op in
+    plain eager single-device execution.  Differentiable (taped via apply_op —
+    the constraint's VJP is the identity with the same sharding)."""
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    data = x._data if isinstance(x, Tensor) else x
+    if isinstance(data, jax.core.Tracer):
+        from ..core.dispatch import apply_op
+        sharding = NamedSharding(mesh, spec)
+        if isinstance(x, Tensor):
+            return apply_op("shard_constraint",
+                            lambda v: jax.lax.with_sharding_constraint(
+                                v, sharding), x, amp=False)
+        return jax.lax.with_sharding_constraint(data, sharding)
+    return x
+
+
+def param_sharding(param, mesh=None):
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return None
+    spec = param.placements if param.placements is not None else P()
+    return NamedSharding(mesh, spec)

@@ -1,0 +1,5 @@
+"""Probability distributions (reference: python/paddle/distribution/)."""
+from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,  # noqa: F401
+                            Distribution, Exponential, Gamma, Geometric,
+                            Gumbel, Laplace, LogNormal, Multinomial, Normal,
+                            Poisson, StudentT, Uniform, kl_divergence)

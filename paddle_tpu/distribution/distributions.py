@@ -1,0 +1,381 @@
+"""Distributions over jax.random / jax.scipy
+(reference: python/paddle/distribution/*.py — 8.1k LoC of kernels+math; on
+TPU the sampling/log_prob math is pure jnp)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..tensor.random import _next_key
+
+
+def _d(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
+
+
+def _w(x):
+    return Tensor._wrap(x)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _w(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _w(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _w(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+
+    @property
+    def stddev(self):
+        return _w(jnp.broadcast_to(self.scale, self._batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return _w(self.loc + self.scale * jax.random.normal(_next_key(), shape))
+
+    def log_prob(self, value):
+        v = _d(value)
+        var = self.scale ** 2
+        return _w(-((v - self.loc) ** 2) / (2 * var)
+                  - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _w(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self._batch_shape))
+
+    def cdf(self, value):
+        return _w(jax.scipy.stats.norm.cdf(_d(value), self.loc, self.scale))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _d(low)
+        self.high = _d(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_next_key(), shape)
+        return _w(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _d(value)
+        inside = (v >= self.low) & (v < self.high)
+        return _w(jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf))
+
+    def entropy(self):
+        return _w(jnp.log(self.high - self.low))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _d(probs)
+        super().__init__(self.probs.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return _w(jax.random.bernoulli(_next_key(), self.probs, shape)
+                  .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _d(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _w(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _w(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _d(logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return _w(jax.random.categorical(_next_key(),
+                                         jnp.log(jnp.maximum(self.logits, 1e-30))
+                                         if (self.logits >= 0).all()
+                                         else self.logits, shape=shape))
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        v = _d(value).astype(jnp.int32)
+        return _w(jnp.take_along_axis(logp, v[..., None], -1)[..., 0])
+
+    def probs(self, value):
+        return _w(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return _w(-jnp.sum(jnp.exp(logp) * logp, -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _d(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return _w(jax.random.exponential(_next_key(), shape) / self.rate)
+
+    def log_prob(self, value):
+        return _w(jnp.log(self.rate) - self.rate * _d(value))
+
+    def entropy(self):
+        return _w(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _d(concentration)
+        self.rate = _d(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return _w(jax.random.gamma(_next_key(), self.concentration, shape)
+                  / self.rate)
+
+    def log_prob(self, value):
+        v = _d(value)
+        a, b = self.concentration, self.rate
+        return _w(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                  - jax.scipy.special.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return _w(a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                  + (1 - a) * jax.scipy.special.digamma(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _d(alpha)
+        self.beta = _d(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return _w(jax.random.beta(_next_key(), self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        v = _d(value)
+        a, b = self.alpha, self.beta
+        return _w((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                  - (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b)))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _d(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return _w(jax.random.dirichlet(_next_key(), self.concentration, shape))
+
+    def log_prob(self, value):
+        v = _d(value)
+        a = self.concentration
+        return _w(jnp.sum((a - 1) * jnp.log(v), -1)
+                  + jax.scipy.special.gammaln(jnp.sum(a, -1))
+                  - jnp.sum(jax.scipy.special.gammaln(a), -1))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return _w(self.loc + self.scale * jax.random.laplace(_next_key(),
+                                                             shape))
+
+    def log_prob(self, value):
+        return _w(-jnp.abs(_d(value) - self.loc) / self.scale
+                  - jnp.log(2 * self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return _w(self.loc + self.scale * jax.random.gumbel(_next_key(),
+                                                            shape))
+
+    def log_prob(self, value):
+        z = (_d(value) - self.loc) / self.scale
+        return _w(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _d(probs)
+        super().__init__(self.probs.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_next_key(), shape)
+        return _w(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        return _w(_d(value) * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return _w(jnp.exp(self.loc + self.scale
+                          * jax.random.normal(_next_key(), shape)))
+
+    def log_prob(self, value):
+        v = _d(value)
+        lv = jnp.log(v)
+        return _w(-((lv - self.loc) ** 2) / (2 * self.scale ** 2)
+                  - jnp.log(self.scale * v) - 0.5 * math.log(2 * math.pi))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _d(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        n = self.probs.shape[-1]
+        cat = jax.random.categorical(
+            _next_key(), jnp.log(jnp.maximum(self.probs, 1e-30)),
+            shape=tuple(shape) + self._batch_shape + (self.total_count,))
+        return _w(jax.nn.one_hot(cat, n).sum(-2))
+
+    def log_prob(self, value):
+        v = _d(value)
+        logp = jnp.log(jnp.maximum(self.probs, 1e-30))
+        coeff = (jax.scipy.special.gammaln(jnp.asarray(self.total_count + 1.0))
+                 - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1))
+        return _w(coeff + jnp.sum(v * logp, -1))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _d(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return _w(jax.random.poisson(_next_key(), self.rate, shape)
+                  .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _d(value)
+        return _w(v * jnp.log(self.rate) - self.rate
+                  - jax.scipy.special.gammaln(v + 1))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _d(df)
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return _w(self.loc + self.scale * jax.random.t(_next_key(), self.df,
+                                                       shape))
+
+    def log_prob(self, value):
+        z = (_d(value) - self.loc) / self.scale
+        df = self.df
+        return _w(jax.scipy.special.gammaln((df + 1) / 2)
+                  - jax.scipy.special.gammaln(df / 2)
+                  - 0.5 * jnp.log(df * math.pi) - jnp.log(self.scale)
+                  - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return _w(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        lp = jax.nn.log_softmax(p.logits, -1)
+        lq = jax.nn.log_softmax(q.logits, -1)
+        return _w(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+        return _w(pp * (jnp.log(pp) - jnp.log(qq))
+                  + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return _w(jnp.log((q.high - q.low) / (p.high - p.low)))
+    # fallback: monte-carlo estimate
+    x = p.sample((256,))
+    return _w(jnp.mean(p.log_prob(x)._data - q.log_prob(x)._data, 0))

@@ -1,0 +1,65 @@
+"""Spectral ops (reference: python/paddle/fft.py, 1669 LoC over
+pocketfft/cuFFT; TPU-native: jnp.fft lowered by XLA)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _mk(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return apply_op(name, lambda v: fn(v, n=n, axis=axis, norm=norm), _t(x))
+    op.__name__ = name
+    return op
+
+
+fft = _mk("fft", jnp.fft.fft)
+ifft = _mk("ifft", jnp.fft.ifft)
+rfft = _mk("rfft", jnp.fft.rfft)
+irfft = _mk("irfft", jnp.fft.irfft)
+hfft = _mk("hfft", jnp.fft.hfft)
+ihfft = _mk("ihfft", jnp.fft.ihfft)
+
+
+def _mkn(name, fn):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        return apply_op(name, lambda v: fn(v, s=s, axes=axes, norm=norm), _t(x))
+    op.__name__ = name
+    return op
+
+
+fft2 = _mkn("fft2", jnp.fft.fft2)
+ifft2 = _mkn("ifft2", jnp.fft.ifft2)
+rfft2 = _mkn("rfft2", jnp.fft.rfft2)
+irfft2 = _mkn("irfft2", jnp.fft.irfft2)
+fftn = _mkn("fftn", jnp.fft.fftn)
+ifftn = _mkn("ifftn", jnp.fft.ifftn)
+rfftn = _mkn("rfftn", jnp.fft.rfftn)
+irfftn = _mkn("irfftn", jnp.fft.irfftn)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    raise NotImplementedError
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor._wrap(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor._wrap(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift", lambda v: jnp.fft.fftshift(v, axes), _t(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift", lambda v: jnp.fft.ifftshift(v, axes), _t(x))

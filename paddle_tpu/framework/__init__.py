@@ -1,0 +1,98 @@
+"""Framework utilities: save/load, dtype defaults, seed.
+
+Reference: python/paddle/framework/ (io.py:743,985 paddle.save/load)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Parameter, Tensor
+
+_DEFAULT_DTYPE = [np.dtype(np.float32)]
+
+
+def set_default_dtype(d):
+    _DEFAULT_DTYPE[0] = np.dtype(dtypes.convert_dtype(d))
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0].name
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return ("__tensor__", np.asarray(obj._data), obj.name)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_saveable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, tuple) and len(obj) == 3 and obj[0] == "__tensor__":
+        if return_numpy:
+            return obj[1]
+        t = Tensor._wrap(jnp.asarray(obj[1]))
+        t.name = obj[2]
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_from_saveable(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save (reference: python/paddle/framework/io.py:743)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    """paddle.load (reference: python/paddle/framework/io.py:985)."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saveable(obj, configs.get("return_numpy", False))
+
+
+def seed(value):
+    from ..tensor import random as _r
+    return _r.seed(value)
+
+
+def get_flags(names):
+    from ..core.flags import get_flags as g
+    return g(names)
+
+
+def set_flags(flags):
+    from ..core.flags import set_flags as s
+    return s(flags)
+
+
+def in_dynamic_mode():
+    return True
+
+
+def in_pir_mode():
+    return False
+
+
+def in_dynamic_or_pir_mode():
+    return True
+
+
+def use_pir_api():
+    return False

@@ -1,0 +1,6 @@
+"""Graph-NN ops (reference: python/paddle/geometric/)."""
+from .message_passing import (segment_max, segment_mean, segment_min,  # noqa: F401
+                              segment_sum, send_u_recv, send_ue_recv,
+                              send_uv)
+from .sampling import sample_neighbors  # noqa: F401
+from .reindex import reindex_graph  # noqa: F401

@@ -1,0 +1,94 @@
+"""Segment ops + message passing (reference: python/paddle/geometric/
+message_passing/ — send_u_recv etc.; kernels phi/kernels/gpu/segment_pool*).
+TPU-native: jax.ops.segment_* (sorted scatter adds lower to efficient XLA)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def _num(count, ids):
+    if count is None:
+        raise ValueError("pass count (num_segments) explicitly on TPU "
+                         "(static shapes required)")
+    return int(count.item()) if isinstance(count, Tensor) else int(count)
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = int(jnp.max(segment_ids._data)) + 1
+    return apply_op("segment_sum",
+                    lambda d, i: jax.ops.segment_sum(d, i, n), data,
+                    segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = int(jnp.max(segment_ids._data)) + 1
+
+    def fn(d, i):
+        s = jax.ops.segment_sum(d, i, n)
+        c = jax.ops.segment_sum(jnp.ones((d.shape[0],) + (1,) * (d.ndim - 1),
+                                         d.dtype), i, n)
+        return s / jnp.maximum(c, 1)
+    return apply_op("segment_mean", fn, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    n = int(jnp.max(segment_ids._data)) + 1
+    return apply_op("segment_max",
+                    lambda d, i: jax.ops.segment_max(d, i, n), data,
+                    segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    n = int(jnp.max(segment_ids._data)) + 1
+    return apply_op("segment_min",
+                    lambda d, i: jax.ops.segment_min(d, i, n), data,
+                    segment_ids)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    n = out_size if out_size is not None else x.shape[0]
+    n = int(n.item()) if isinstance(n, Tensor) else int(n)
+    red = {"sum": jax.ops.segment_sum, "mean": None,
+           "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+
+    def fn(v, s, d):
+        gathered = jnp.take(v, s, axis=0)
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(gathered, d, n)
+            cnt = jax.ops.segment_sum(jnp.ones((gathered.shape[0],) + (1,) * (gathered.ndim - 1), v.dtype), d, n)
+            return tot / jnp.maximum(cnt, 1)
+        return red[reduce_op](gathered, d, n)
+    return apply_op("send_u_recv", fn, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    n = out_size if out_size is not None else x.shape[0]
+    n = int(n.item()) if isinstance(n, Tensor) else int(n)
+
+    def fn(v, e, s, d):
+        gathered = jnp.take(v, s, axis=0)
+        msg = {"add": gathered + e, "sub": gathered - e,
+               "mul": gathered * e, "div": gathered / e}[message_op]
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msg, d, n)
+            cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],) + (1,) * (msg.ndim - 1), v.dtype), d, n)
+            return tot / jnp.maximum(cnt, 1)
+        return {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+                "min": jax.ops.segment_min}[reduce_op](msg, d, n)
+    return apply_op("send_ue_recv", fn, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    def fn(a, b, s, d):
+        ga = jnp.take(a, s, axis=0)
+        gb = jnp.take(b, d, axis=0)
+        return {"add": ga + gb, "sub": ga - gb, "mul": ga * gb,
+                "div": ga / gb}[message_op]
+    return apply_op("send_uv", fn, x, y, src_index, dst_index)

@@ -1,0 +1,24 @@
+"""Graph reindex (reference: python/paddle/geometric/reindex.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    import jax.numpy as jnp
+    xs = np.asarray(x._data)
+    nb = np.asarray(neighbors._data)
+    uniq = {}
+    for v in xs.tolist():
+        uniq.setdefault(v, len(uniq))
+    for v in nb.tolist():
+        uniq.setdefault(v, len(uniq))
+    remap = np.vectorize(uniq.get)
+    out_nodes = np.asarray(sorted(uniq, key=uniq.get))
+    return (Tensor._wrap(jnp.asarray(remap(nb) if len(nb) else nb)),
+            Tensor._wrap(jnp.asarray(out_nodes)),
+            Tensor._wrap(jnp.asarray(remap(xs) if len(xs) else xs)))

@@ -1,0 +1,26 @@
+"""Graph sampling (reference: python/paddle/geometric/sampling/) — host-side
+numpy (irregular; not a TPU op)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    r = np.asarray(row._data)
+    cp = np.asarray(colptr._data)
+    nodes = np.asarray(input_nodes._data)
+    out_rows, out_counts = [], []
+    for n in nodes:
+        nbrs = r[cp[n]:cp[n + 1]]
+        if sample_size > 0 and len(nbrs) > sample_size:
+            nbrs = np.random.choice(nbrs, sample_size, replace=False)
+        out_rows.append(nbrs)
+        out_counts.append(len(nbrs))
+    import jax.numpy as jnp
+    return (Tensor._wrap(jnp.asarray(np.concatenate(out_rows) if out_rows
+                                     else np.zeros(0, r.dtype))),
+            Tensor._wrap(jnp.asarray(np.asarray(out_counts, np.int64))))

@@ -1,0 +1,304 @@
+"""High-level API (reference: python/paddle/hapi/model.py:1052 paddle.Model
+fit/evaluate/predict + callbacks)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Callback:
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float) else
+                              f"{k}: {v}" for k, v in (logs or {}).items())
+            print(f"epoch {self.epoch} step {step}: {items}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/{epoch}")
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        self.monitor = monitor
+        self.patience = patience
+        self.best = None
+        self.wait = 0
+        self.stopped = False
+        self.mode = "min" if mode in ("auto", "min") else "max"
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        better = (self.best is None
+                  or (cur < self.best if self.mode == "min" else cur > self.best))
+        if better:
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped = True
+                self.model.stop_training = True
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..optimizer.lr import LRScheduler as Sched
+        opt = self.model._optimizer
+        if self.by_step and isinstance(opt._learning_rate, Sched):
+            opt._learning_rate.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        from ..optimizer.lr import LRScheduler as Sched
+        opt = self.model._optimizer
+        if self.by_epoch and isinstance(opt._learning_rate, Sched):
+            opt._learning_rate.step()
+
+
+class Model:
+    """paddle.Model (reference: hapi/model.py:1052)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics else [])
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*inputs)
+        losses = []
+        if labels is not None:
+            labels = labels if isinstance(labels, (list, tuple)) else [labels]
+            loss = self._loss(outs, *labels) if not isinstance(
+                outs, (list, tuple)) else self._loss(*outs, *labels)
+            losses.append(loss)
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        return [l.numpy() for l in losses]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*inputs)
+        metrics = []
+        if labels is not None and self._loss is not None:
+            labels = labels if isinstance(labels, (list, tuple)) else [labels]
+            loss = self._loss(outs, *labels)
+            metrics.append(loss.numpy())
+        return metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*inputs)
+        return [o.numpy() for o in (outs if isinstance(outs, (list, tuple))
+                                    else [outs])]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        cbs = [ProgBarLogger(log_freq, verbose)] + (callbacks or [])
+        for cb in cbs:
+            cb.set_model(self)
+        for cb in cbs:
+            cb.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(train_loader):
+                data, label = (batch[:-1], batch[-1]) if isinstance(
+                    batch, (list, tuple)) and len(batch) > 1 else (batch, None)
+                self.network.train()
+                data_list = list(data) if isinstance(data, (list, tuple)) \
+                    else [data]
+                outs = self.network(*data_list)
+                loss = self._loss(outs, label)
+                loss.backward()
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+                logs = {"loss": float(loss.numpy())}
+                for m in self._metrics:
+                    corr = m.compute(outs, label)
+                    res = m.update(corr)
+                    logs[m.name()[0] if isinstance(m.name(), list)
+                         else m.name()] = res
+                for cb in cbs:
+                    cb.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            for cb in cbs:
+                cb.on_epoch_end(epoch, {})
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              num_workers=num_workers, verbose=verbose)
+            if self.stop_training:
+                break
+        for cb in cbs:
+            cb.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            data, label = (batch[:-1], batch[-1]) if isinstance(
+                batch, (list, tuple)) and len(batch) > 1 else (batch, None)
+            data_list = list(data) if isinstance(data, (list, tuple)) else [data]
+            outs = self.network(*data_list)
+            if self._loss is not None and label is not None:
+                losses.append(float(self._loss(outs, label).numpy()))
+            for m in self._metrics:
+                m.update(m.compute(outs, label))
+        res = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            name = m.name()[0] if isinstance(m.name(), list) else m.name()
+            res[name] = m.accumulate()
+        if verbose:
+            print("Eval:", res)
+        return res
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        self.network.eval()
+        outputs = []
+        for batch in loader:
+            data = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outputs.append(self.predict_batch([data]))
+        return outputs
+
+    def save(self, path, training=True):
+        from ..framework import save as psave
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import load as pload
+        state = pload(path + ".pdparams")
+        self.network.set_state_dict(state)
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtype)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """paddle.summary (reference: hapi/model_summary.py)."""
+    lines = []
+    total_params = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total_params += n
+        if p.trainable:
+            trainable += n
+        lines.append(f"  {name:60s} {str(p.shape):20s} {n:>12,d}")
+    header = f"{'Layer (param name)':62s} {'Shape':20s} {'Param #':>12s}"
+    sep = "-" * len(header)
+    print("\n".join([sep, header, sep] + lines + [sep]))
+    print(f"Total params: {total_params:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(sep)
+    return {"total_params": total_params, "trainable_params": trainable}

@@ -1,0 +1,36 @@
+"""paddle.incubate surface (reference: python/paddle/incubate/ — fused-op
+APIs, asp, autotune).  The fused ops map to paddle_tpu kernels / XLA-fused
+chains."""
+
+from . import nn  # noqa: F401
+from .nn import functional  # noqa: F401
+
+
+def autotune(config=None):
+    """reference: incubate/autotune.py — XLA autotunes internally; no-op."""
+    return None
+
+
+class asp:
+    """2:4 structured sparsity (reference: incubate/asp/) — mask utilities."""
+
+    @staticmethod
+    def calculate_density(x):
+        import numpy as np
+        d = np.asarray(x._data if hasattr(x, "_data") else x)
+        return float((d != 0).sum() / d.size)
+
+    @staticmethod
+    def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+        import numpy as np
+        import jax.numpy as jnp
+        from ..nn import Linear
+        for lay in model.sublayers(include_self=True):
+            if isinstance(lay, Linear):
+                w = np.asarray(lay.weight._data)
+                flat = w.reshape(-1, m)
+                idx = np.argsort(np.abs(flat), axis=1)[:, : m - n]
+                mask = np.ones_like(flat)
+                np.put_along_axis(mask, idx, 0.0, axis=1)
+                lay.weight._data = jnp.asarray((flat * mask).reshape(w.shape))
+        return model

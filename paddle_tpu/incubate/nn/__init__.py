@@ -1,0 +1,5 @@
+"""incubate.nn — fused layers (reference: python/paddle/incubate/nn/)."""
+
+from . import functional  # noqa: F401
+from .layer import (FusedEcMoe, FusedFeedForward, FusedLinear,  # noqa: F401
+                    FusedMultiHeadAttention, FusedTransformerEncoderLayer)

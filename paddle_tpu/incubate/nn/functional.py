@@ -1,0 +1,247 @@
+"""incubate.nn.functional — fused-op API surface
+(reference: python/paddle/incubate/nn/functional/ — fused_rms_norm,
+fused_rotary_position_embedding, swiglu, fused_linear,
+masked_multihead_attention, fused_bias_act ...).
+
+On TPU these map to Pallas kernels (rms_norm, flash attention) or
+XLA-fused jnp chains — XLA's fusion pass is the analogue of the reference's
+hand-written fused CUDA kernels (phi/kernels/fusion/)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op, matmul_precision
+from ...core.tensor import Tensor
+from ...nn.functional.activation import swiglu  # noqa: F401
+from ...nn.functional.norm import rms_norm as _rms_norm
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0):
+    """reference: incubate/nn/functional/fused_rms_norm.py"""
+    if residual is not None:
+        x = x + residual
+    if bias is not None:
+        x = x + bias
+    out = _rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    if residual is not None:
+        return out, x
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None,
+                     quant_scale=-1, **kw):
+    from ...nn.functional.norm import layer_norm
+    if residual is not None:
+        x = x + residual
+    if bias is not None:
+        x = x + bias
+    out = layer_norm(x, x.shape[-1], norm_weight, norm_bias, epsilon)
+    if residual is not None:
+        return out, x
+    return out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    rotary_emb_base=10000.0):
+    """RoPE (reference: incubate/nn/functional/fused_rotary_position_embedding.py;
+    CUDA kernel fusion/gpu/fused_rope_kernel.cu). [B, S, H, D] layout."""
+    from ...kernels.rope import apply_rope
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        outs.append(apply_op(
+            "fused_rope",
+            lambda x, s=sin, c=cos: apply_rope(
+                x, None if s is None else (s._data if isinstance(s, Tensor) else s),
+                None if c is None else (c._data if isinstance(c, Tensor) else c),
+                use_neox_rotary_style, rotary_emb_base), t))
+    return tuple(outs)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def fn(a, w, *b):
+        if transpose_weight:
+            w = w.T
+        out = jnp.matmul(a, w, precision=matmul_precision())
+        if b:
+            out = out + b[0]
+        return out
+    if bias is not None:
+        return apply_op("fused_linear", fn, x, weight, bias)
+    return apply_op("fused_linear", fn, x, weight)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    def fn(a, w, b):
+        if trans_x:
+            a = a.T
+        if trans_y:
+            w = w.T
+        out = jnp.matmul(a, w, precision=matmul_precision()) + b
+        if activation == "gelu":
+            return jax.nn.gelu(out)
+        if activation == "relu":
+            return jax.nn.relu(out)
+        return out
+    return apply_op("fused_linear_activation", fn, x, y, bias)
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method="gelu", compute_dtype="default", quant_scale=-1,
+                   quant_round_type=0, quant_max_bound=0, quant_min_bound=0):
+    """reference CUDA: fusion/gpu/fused_bias_act_kernel.cu"""
+    def fn(v, *b):
+        if b:
+            v = v + b[0]
+        if act_method in ("gelu",):
+            return jax.nn.gelu(v)
+        if act_method == "relu":
+            return jax.nn.relu(v)
+        if act_method in ("swiglu", "silu"):
+            return jax.nn.silu(v)
+        if act_method == "geglu":
+            a, g = jnp.split(v, 2, -1)
+            return jax.nn.gelu(a) * g
+        return v
+    if bias is not None:
+        return apply_op("fused_bias_act", fn, x, bias)
+    return apply_op("fused_bias_act", fn, x)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    from ...nn.functional.common import dropout
+    return dropout(x, p, training=training, mode=mode) + y
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    """Fused attention block (reference: incubate fused_attention op,
+    fluid/operators/fused/fused_attention_op.cu) — composed from flash
+    attention + XLA-fused projections."""
+    from ...nn.functional import scaled_dot_product_attention, dropout
+    from ...nn.functional.norm import layer_norm
+    from ...tensor.manipulation import reshape
+
+    residual = x
+    if pre_layer_norm:
+        x = layer_norm(x, x.shape[-1], pre_ln_scale, pre_ln_bias,
+                       pre_ln_epsilon)
+    b, s, d = x.shape
+    # qkv_weight layout [3, n_heads, head_dim, d]
+    def qkv_fn(v, w, *bias):
+        wt = w.reshape(3 * w.shape[1] * w.shape[2], w.shape[3]).T
+        out = jnp.matmul(v, wt, precision=matmul_precision())
+        if bias:
+            out = out + bias[0].reshape(-1)
+        return out
+    if qkv_bias is not None:
+        qkv = apply_op("fused_qkv", qkv_fn, x, qkv_weight, qkv_bias)
+    else:
+        qkv = apply_op("fused_qkv", qkv_fn, x, qkv_weight)
+    nh = qkv_weight.shape[1]
+    hd = qkv_weight.shape[2]
+    qkv = reshape(qkv, [b, s, 3, nh, hd])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    out = scaled_dot_product_attention(q, k, v, attn_mask,
+                                       attn_dropout_rate if training else 0.0)
+    out = reshape(out, [b, s, nh * hd])
+    from ...nn.functional.common import linear
+    out = linear(out, linear_weight, linear_bias)
+    out = dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = layer_norm(out, out.shape[-1], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode="upscale_in_train",
+                      ring_id=-1, name=None):
+    """reference: fluid/operators/fused/fused_feedforward_op.cu"""
+    from ...nn.functional import dropout, gelu, relu
+    from ...nn.functional.common import linear
+    from ...nn.functional.norm import layer_norm
+
+    residual = x
+    if pre_layer_norm:
+        x = layer_norm(x, x.shape[-1], ln1_scale, ln1_bias, ln1_epsilon)
+    act = gelu if activation == "gelu" else relu
+    out = linear(x, linear1_weight, linear1_bias)
+    out = dropout(act(out), dropout1_rate, training=training, mode=mode)
+    out = linear(out, linear2_weight, linear2_bias)
+    out = dropout(out, dropout2_rate, training=training, mode=mode)
+    out = residual + out
+    if not pre_layer_norm:
+        out = layer_norm(out, out.shape[-1], ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               **kwargs):
+    raise NotImplementedError(
+        "decode-time MMHA: use paddle_tpu.nn.MultiHeadAttention with cache")
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu"):
+    """Expert-choice MoE (reference: incubate/nn/layer/fused_ec_moe.py) —
+    dense einsum dispatch (MXU-friendly)."""
+    def fn(v, g, w0, b0, w1, b1):
+        b, s, d = v.shape
+        e = w0.shape[0]
+        probs = jax.nn.softmax(g, -1)  # [b, s, e]
+        h = jnp.einsum("bsd,edh->bseh", v, w0,
+                       precision=matmul_precision()) + b0[None, None]
+        h = jax.nn.gelu(h) if act_type == "gelu" else jax.nn.relu(h)
+        o = jnp.einsum("bseh,ehd->bsed", h, w1,
+                       precision=matmul_precision()) + b1[None, None]
+        return jnp.einsum("bsed,bse->bsd", o, probs)
+    return apply_op("fused_ec_moe", fn, x, gate, bmm0_weight, bmm0_bias,
+                    bmm1_weight, bmm1_bias)
+
+
+def fused_matmul_bias(x, y, bias=None, trans_x=False, trans_y=False,
+                      name=None):
+    return fused_linear_activation(x, y, bias if bias is not None else
+                                   Tensor(jnp.zeros(y.shape[0 if trans_y else -1])),
+                                   trans_x, trans_y, activation="none")
+
+
+def block_multihead_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "paged-KV inference attention lands with the serving stack; "
+        "use scaled_dot_product_attention")
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False):
+    from ...nn.functional import scaled_dot_product_attention
+    return scaled_dot_product_attention(query, key, value, mask,
+                                        is_causal=causal)

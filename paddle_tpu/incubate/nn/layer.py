@@ -1,0 +1,175 @@
+"""incubate.nn fused layers (reference: python/paddle/incubate/nn/layer/)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.functional.init_utils import param_attr_init
+from ...nn.initializer import Constant, XavierUniform
+from ...nn.layer.layers import Layer
+from . import functional as F
+
+
+class FusedLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = param_attr_init(shape, self._dtype, weight_attr, False,
+                                      XavierUniform())
+        self.bias = (param_attr_init((out_features,), self._dtype, bias_attr,
+                                     True, Constant(0.0))
+                     if bias_attr is not False else None)
+        self._transpose_weight = transpose_weight
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias,
+                              self._transpose_weight)
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+        self.qkv_weight = param_attr_init((3, num_heads, head_dim, embed_dim),
+                                          self._dtype, qkv_weight_attr, False,
+                                          XavierUniform())
+        self.qkv_bias = param_attr_init((3, num_heads, head_dim), self._dtype,
+                                        qkv_bias_attr, True, Constant(0.0))
+        self.linear_weight = param_attr_init((embed_dim, embed_dim),
+                                             self._dtype, linear_weight_attr,
+                                             False, XavierUniform())
+        self.linear_bias = param_attr_init((embed_dim,), self._dtype,
+                                           linear_bias_attr, True,
+                                           Constant(0.0))
+        self.pre_ln_scale = param_attr_init((embed_dim,), self._dtype,
+                                            pre_ln_scale_attr, False,
+                                            Constant(1.0))
+        self.pre_ln_bias = param_attr_init((embed_dim,), self._dtype,
+                                           pre_ln_bias_attr, True,
+                                           Constant(0.0))
+        self.ln_scale = param_attr_init((embed_dim,), self._dtype,
+                                        ln_scale_attr, False, Constant(1.0))
+        self.ln_bias = param_attr_init((embed_dim,), self._dtype, ln_bias_attr,
+                                       True, Constant(0.0))
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return F.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            self.normalize_before, self.pre_ln_scale, self.pre_ln_bias,
+            self.ln_scale, self.ln_bias, self._epsilon, self.qkv_bias,
+            self.linear_bias, cache, attn_mask, self.dropout_rate,
+            self.attn_dropout_rate, self._epsilon, self.training)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self._epsilon = epsilon
+        self.linear1_weight = param_attr_init((d_model, dim_feedforward),
+                                              self._dtype,
+                                              linear1_weight_attr, False,
+                                              XavierUniform())
+        self.linear1_bias = param_attr_init((dim_feedforward,), self._dtype,
+                                            linear1_bias_attr, True,
+                                            Constant(0.0))
+        self.linear2_weight = param_attr_init((dim_feedforward, d_model),
+                                              self._dtype,
+                                              linear2_weight_attr, False,
+                                              XavierUniform())
+        self.linear2_bias = param_attr_init((d_model,), self._dtype,
+                                            linear2_bias_attr, True,
+                                            Constant(0.0))
+        self.ln1_scale = param_attr_init((d_model,), self._dtype,
+                                         ln1_scale_attr, False, Constant(1.0))
+        self.ln1_bias = param_attr_init((d_model,), self._dtype, ln1_bias_attr,
+                                        True, Constant(0.0))
+        self.ln2_scale = param_attr_init((d_model,), self._dtype,
+                                         ln2_scale_attr, False, Constant(1.0))
+        self.ln2_bias = param_attr_init((d_model,), self._dtype, ln2_bias_attr,
+                                        True, Constant(0.0))
+
+    def forward(self, src, cache=None):
+        return F.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight, self.linear1_bias,
+            self.linear2_bias, self.ln1_scale, self.ln1_bias, self.ln2_scale,
+            self.ln2_bias, self.act_dropout_rate, self.dropout_rate,
+            self.activation, self._epsilon, self._epsilon,
+            self.normalize_before, self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedEcMoe(Layer):
+    """reference: incubate/nn/layer/fused_ec_moe.py"""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu",
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.act_type = act_type
+        self.bmm0_weight = param_attr_init(
+            (num_experts, hidden_size, inter_size), self._dtype, weight_attr,
+            False, XavierUniform())
+        self.bmm0_bias = param_attr_init((num_experts, 1, inter_size),
+                                         self._dtype, bias_attr, True,
+                                         Constant(0.0))
+        self.bmm1_weight = param_attr_init(
+            (num_experts, inter_size, hidden_size), self._dtype, weight_attr,
+            False, XavierUniform())
+        self.bmm1_bias = param_attr_init((num_experts, 1, hidden_size),
+                                         self._dtype, bias_attr, True,
+                                         Constant(0.0))
+
+    def forward(self, x, gate):
+        def squeeze1(b):
+            return Tensor._wrap(b._data[:, 0, :])
+        return F.fused_ec_moe(x, gate, self.bmm0_weight,
+                              squeeze1(self.bmm0_bias), self.bmm1_weight,
+                              squeeze1(self.bmm1_bias), self.act_type)

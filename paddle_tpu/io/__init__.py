@@ -1,0 +1,398 @@
+"""Data pipeline (reference: python/paddle/io/ — Dataset/DataLoader,
+dataloader_iter.py multiprocess workers + LoDTensorBlockingQueue async
+staging).
+
+TPU-native: the host pipeline produces numpy batches on background threads
+(prefetch queue = the BlockingQueue analogue); device transfer happens once
+per step (jnp.asarray) and overlaps with compute thanks to XLA async dispatch.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset does not support indexing")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __len__(self):
+        return min(len(d) for d in self.datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, (tuple, list)) else [item])
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        return itertools.chain(*self.datasets)
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cum = np.cumsum([len(d) for d in self.datasets]).tolist()
+
+    def __len__(self):
+        return self.cum[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        di = int(np.searchsorted(self.cum, idx, side="right"))
+        prev = 0 if di == 0 else self.cum[di - 1]
+        return self.datasets[di][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    lengths = list(lengths)
+    if all(isinstance(l, float) for l in lengths) and abs(sum(lengths) - 1) < 1e-6:
+        n = len(dataset)
+        lengths = [int(np.floor(n * f)) for f in lengths]
+        lengths[-1] = n - sum(lengths[:-1])
+    if sum(lengths) != len(dataset):
+        raise ValueError("sum of lengths != dataset size")
+    perm = np.random.permutation(len(dataset)).tolist()
+    out, ofs = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[ofs:ofs + l]))
+        ofs += l
+    return out
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num = num_samples
+
+    @property
+    def num_samples(self):
+        return self._num if self._num is not None else len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.replacement:
+            return iter(np.random.randint(0, n, self.num_samples).tolist())
+        return iter(np.random.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(
+            weights.numpy() if isinstance(weights, Tensor) else weights,
+            dtype=np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(p), self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shards indices across data-parallel ranks (reference:
+    io/dataloader/batch_sampler.py DistributedBatchSampler)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        from ..distributed import get_rank, get_world_size
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None else \
+            get_world_size()
+        self.local_rank = rank if rank is not None else get_rank()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.num_samples = int(np.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            rng.shuffle(indices)
+        indices = np.concatenate(
+            [indices, indices[: self.total_size - n]])
+        indices = indices[self.local_rank::self.nranks]
+        batch = []
+        for idx in indices:
+            batch.append(int(idx))
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, Tensor):
+        from ..tensor.manipulation import stack
+        return stack(batch, 0)
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, np.float32))
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+class _PrefetchIter:
+    """Background-thread prefetcher — the BlockingQueue analogue
+    (reference: io/dataloader/dataloader_iter.py:365 multiprocess loop)."""
+
+    def __init__(self, loader, index_iter):
+        self._loader = loader
+        self._index_iter = index_iter
+        self._queue = queue.Queue(maxsize=max(2, loader.prefetch_factor))
+        self._done = object()
+        self._threads = []
+        self._index_lock = threading.Lock()
+        self._stop = threading.Event()
+        n = max(1, loader.num_workers)
+        # ordered fetch: single index stream, workers pull next batch index
+        self._order = 0
+        self._pending = {}
+        self._order_lock = threading.Lock()
+        self._seq = itertools.count()
+        for _ in range(n):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._emitted = 0
+        self._next_emit = 0
+        self._results = {}
+        self._cv = threading.Condition()
+
+    def _worker(self):
+        while not self._stop.is_set():
+            with self._index_lock:
+                try:
+                    indices = next(self._index_iter)
+                    seq = next(self._seq)
+                except StopIteration:
+                    break
+            try:
+                batch = self._fetch(indices)
+            except Exception as e:  # propagate
+                batch = e
+            with self._cv:
+                self._results[seq] = batch
+                self._cv.notify_all()
+        with self._cv:
+            self._results.setdefault("done", None)
+            self._cv.notify_all()
+
+    def _fetch(self, indices):
+        data = [self._loader.dataset[i] for i in indices]
+        cf = self._loader.collate_fn or default_collate_fn
+        return cf(data)
+
+    def __next__(self):
+        with self._cv:
+            while True:
+                if self._next_emit in self._results:
+                    batch = self._results.pop(self._next_emit)
+                    self._next_emit += 1
+                    if isinstance(batch, Exception):
+                        raise batch
+                    return batch
+                if "done" in self._results and not any(
+                        isinstance(k, int) and k >= self._next_emit
+                        for k in self._results):
+                    alive = any(t.is_alive() for t in self._threads)
+                    if not alive:
+                        raise StopIteration
+                self._cv.wait(timeout=0.05)
+
+    def __iter__(self):
+        return self
+
+    def __del__(self):
+        self._stop.set()
+
+
+class DataLoader:
+    """reference: python/paddle/io/reader.py:216 DataLoader."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self._is_iterable = isinstance(dataset, IterableDataset)
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        elif self._is_iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+            self.batch_size = batch_size
+
+    def __len__(self):
+        if self._is_iterable:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def _iter_iterable(self):
+        cf = self.collate_fn or default_collate_fn
+        batch = []
+        for item in self.dataset:
+            batch.append(item)
+            if len(batch) == self.batch_size:
+                yield cf(batch)
+                batch = []
+        if batch and not getattr(self, "drop_last", False):
+            yield cf(batch)
+
+    def __iter__(self):
+        if self._is_iterable:
+            return self._iter_iterable()
+        index_iter = iter(self.batch_sampler)
+        if self.num_workers == 0:
+            def gen():
+                cf = self.collate_fn or default_collate_fn
+                for indices in index_iter:
+                    yield cf([self.dataset[i] for i in indices])
+            return gen()
+        return _PrefetchIter(self, index_iter)
+
+
+def get_worker_info():
+    return None

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels (reference analogue: phi/kernels/fusion/ hand-written
+CUDA kernels + the Kernel Primitive abstraction phi/kernels/primitive/).
+
+Each kernel ships a Pallas implementation for TPU plus a jnp reference used
+off-TPU and in interpret-mode tests."""
+
+from . import flash_attention, rms_norm, rope  # noqa: F401

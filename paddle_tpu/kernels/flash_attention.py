@@ -1,0 +1,272 @@
+"""Flash attention — Pallas TPU kernel with custom VJP.
+
+Reference analogue: phi/kernels/gpu/flash_attn_kernel.cu (wrapping the
+flash-attn CUDA lib).  TPU-native design: online-softmax tiled attention where
+q/k/v blocks stream HBM→VMEM and the two matmuls per tile hit the MXU;
+backward recomputes attention probabilities per tile (flash-attention-2
+style), avoiding O(S^2) residuals.
+
+Layout: [B, S, H, D] (paddle convention) — internally [B, H, S, D].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_INTERPRET = [False]  # tests flip this on CPU
+
+
+def _on_tpu():
+    return jax.devices()[0].platform in ("tpu", "axon")
+
+
+def reference_attention(q, k, v, causal=False, scale=None):
+    """jnp reference ([B, S, H, D]); also the off-TPU fallback."""
+    d = q.shape[-1]
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32) * sc,
+                        k.astype(jnp.float32))
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), dtype=bool), t - s)
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhst,bthd->bshd", p.astype(v.dtype), v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k, seq_len):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, d]
+    block_q = q.shape[0]
+    qi = pl.program_id(2)
+
+    def body(start_k, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, 0, pl.dslice(start_k * block_k, block_k)].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(start_k * block_k, block_k)].astype(jnp.float32)
+        s = q @ k.T  # [block_q, block_k] — MXU
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = start_k * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + p @ v
+        return acc, m_new, l_new
+
+    num_k = seq_len // block_k
+    if causal:
+        # only key blocks up to (and including) the diagonal participate
+        num_k_run = jnp.minimum(num_k, pl.cdiv((qi + 1) * block_q, block_k))
+    else:
+        num_k_run = num_k
+    acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    m0 = jnp.full((block_q,), -1e30, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_k_run, body, (acc0, m0, l0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q=128, block_k=128):
+    from jax.experimental import pallas as pl
+
+    b, h, s, d = q.shape
+    grid = (b, h, s // block_q)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=block_k, seq_len=s)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+        ],
+        interpret=_INTERPRET[0],
+    )(q, k, v)
+    return out, lse
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale, causal, block_k, seq_len):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    block_q = q.shape[0]
+    qi = pl.program_id(2)
+
+    def body(start_k, dq):
+        k = k_ref[0, 0, pl.dslice(start_k * block_k, block_k)].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(start_k * block_k, block_k)].astype(jnp.float32)
+        s = q @ k.T
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = start_k * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        p = jnp.exp(s - lse[:, None])
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        return dq + ds @ k
+
+    num_k = seq_len // block_k
+    if causal:
+        num_k_run = jnp.minimum(num_k, pl.cdiv((qi + 1) * block_q, block_k))
+    else:
+        num_k_run = num_k
+    dq = jax.lax.fori_loop(0, num_k_run, body,
+                           jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                    dv_ref, *, scale, causal, block_q, seq_len):
+    from jax.experimental import pallas as pl
+
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    block_k = k.shape[0]
+    ki = pl.program_id(2)
+
+    def body(start_q, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.dslice(start_q * block_q, block_q)].astype(
+            jnp.float32) * scale
+        do = do_ref[0, 0, pl.dslice(start_q * block_q, block_q)].astype(
+            jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(start_q * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.dslice(start_q * block_q, block_q)]
+        s = q @ k.T  # [block_q, block_k]
+        if causal:
+            q_pos = start_q * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        # q here is already q*scale, so ds.T @ q == sum_i ds_ij * scale * q_i
+        dk = dk + ds.T @ q
+        return dk, dv
+
+    num_q = seq_len // block_q
+    if causal:
+        start = (ki * block_k) // block_q
+    else:
+        start = 0
+    dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dv0 = jnp.zeros((block_k, v.shape[-1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start if causal else 0, num_q, body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q=128, block_k=128):
+    from jax.experimental import pallas as pl
+
+    b, h, s, d = q.shape
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_len=s),
+        grid=(b, h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        interpret=_INTERPRET[0],
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, seq_len=s),
+        grid=(b, h, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s), lambda bi, hi, ki: (bi, hi, 0)),
+            pl.BlockSpec((1, 1, s), lambda bi, hi, ki: (bi, hi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        ],
+        interpret=_INTERPRET[0],
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_bhsd(q, k, v, causal, scale):
+    out, _ = _flash_fwd(q, k, v, causal, scale)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale):
+    out, lse = _flash_fwd(q, k, v, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, scale, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, causal, scale)
+    return dq, dk, dv
+
+
+_flash_attention_bhsd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention_fwd(q, k, v, causal=False, scale=None):
+    """Public entry, [B, S, H, D] layout; differentiable (custom VJP)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if not (_on_tpu() or _INTERPRET[0]):
+        return reference_attention(q, k, v, causal, scale)
+    s = q.shape[1]
+    if s % 128 != 0:
+        return reference_attention(q, k, v, causal, scale)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash_attention_bhsd(qt, kt, vt, causal, scale)
+    return jnp.swapaxes(out, 1, 2)

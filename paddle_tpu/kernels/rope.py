@@ -1,0 +1,41 @@
+"""Rotary position embedding (reference CUDA:
+phi/kernels/fusion/gpu/fused_rope_kernel.cu).  Pure jnp — XLA fuses the
+elementwise chain; a Pallas kernel buys nothing here (bandwidth-bound,
+already fused)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_tables(seq_len, head_dim, base=10000.0, dtype=jnp.float32):
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                          / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [S, D/2]
+    return jnp.sin(freqs).astype(dtype), jnp.cos(freqs).astype(dtype)
+
+
+def apply_rope(x, sin=None, cos=None, neox=True, base=10000.0):
+    """x: [B, S, H, D]."""
+    b, s, h, d = x.shape
+    if sin is None or cos is None:
+        sin, cos = rope_tables(s, d, base, jnp.float32)
+    else:
+        # paddle passes [1, S, 1, D] tables with duplicated halves
+        sin = sin.reshape(s, -1)[:, : d // 2].astype(jnp.float32)
+        cos = cos.reshape(s, -1)[:, : d // 2].astype(jnp.float32)
+    sin = sin[None, :, None, :]
+    cos = cos[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    if neox:
+        x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+    else:
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    if neox:
+        out = jnp.concatenate([r1, r2], axis=-1)
+    else:
+        out = jnp.stack([r1, r2], axis=-1).reshape(xf.shape)
+    return out.astype(x.dtype)

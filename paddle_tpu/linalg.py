@@ -1,0 +1,9 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py)."""
+
+from .tensor.linalg import (cholesky, cholesky_inverse, cholesky_solve,  # noqa: F401
+                            cond, corrcoef, cov, det, eig, eigh, eigvals,
+                            eigvalsh, householder_product, inv, lstsq, lu,
+                            lu_unpack, matmul, matrix_exp, matrix_norm,
+                            matrix_power, matrix_rank, multi_dot, norm,
+                            pca_lowrank, pinv, qr, slogdet, solve, svd,
+                            svdvals, triangular_solve, vector_norm)

@@ -1,0 +1,166 @@
+"""Metrics (reference: python/paddle/metric/metrics.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    import jax.numpy as jnp
+    logits = input._data
+    lab = label._data
+    if lab.ndim == logits.ndim:
+        lab = lab.reshape(lab.shape[:-1] + (1,))[..., 0] if lab.shape[-1] == 1 \
+            else jnp.argmax(lab, -1)
+    import jax
+    _, topi = jax.lax.top_k(logits, k)
+    hit = jnp.any(topi == lab[..., None], axis=-1)
+    return Tensor._wrap(jnp.mean(hit.astype(jnp.float32)))
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self._name
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name="acc", *args, **kwargs):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label, *args):
+        import jax
+        import jax.numpy as jnp
+        logits = pred._data
+        lab = label._data
+        if lab.ndim == logits.ndim and lab.shape[-1] != 1:
+            lab = jnp.argmax(lab, -1, keepdims=True)
+        elif lab.ndim == logits.ndim - 1:
+            lab = lab[..., None]
+        _, topi = jax.lax.top_k(logits, self.maxk)
+        correct = (topi == lab).astype(jnp.float32)
+        return Tensor._wrap(correct)
+
+    def update(self, correct, *args):
+        c = np.asarray(correct._data if isinstance(correct, Tensor) else correct)
+        accs = []
+        for i, k in enumerate(self.topk):
+            num = c[..., :k].sum()
+            self.total[i] += float(num)
+            self.count[i] += int(np.prod(c.shape[:-1]))
+            accs.append(self.total[i] / max(self.count[i], 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return [f"{self._name}_top{k}" for k in self.topk] \
+            if len(self.topk) > 1 else [self._name]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision", *args, **kwargs):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._data if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels._data if isinstance(labels, Tensor) else labels)
+        pred_pos = (p > 0.5).astype(np.int32).reshape(-1)
+        l = l.reshape(-1)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fp += int(((pred_pos == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        ap = self.tp + self.fp
+        return self.tp / ap if ap else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name="recall", *args, **kwargs):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._data if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels._data if isinstance(labels, Tensor) else labels)
+        pred_pos = (p > 0.5).astype(np.int32).reshape(-1)
+        l = l.reshape(-1)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fn += int(((pred_pos == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        ap = self.tp + self.fn
+        return self.tp / ap if ap else 0.0
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc", *args,
+                 **kwargs):
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._data if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels._data if isinstance(labels, Tensor) else labels
+                       ).reshape(-1)
+        if p.ndim == 2:
+            p = p[:, -1]
+        else:
+            p = p.reshape(-1)
+        bins = np.minimum((p * self.num_thresholds).astype(np.int64),
+                          self.num_thresholds)
+        for b, y in zip(bins, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        area = 0.0
+        pos = neg = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = pos + self._stat_pos[i]
+            new_neg = neg + self._stat_neg[i]
+            area += (new_neg - neg) * (pos + new_pos) / 2
+            pos, neg = new_pos, new_neg
+        return area / (tot_pos * tot_neg)

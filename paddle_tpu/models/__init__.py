@@ -1,0 +1,5 @@
+"""Flagship model zoo (reference capability: PaddleNLP GPT/BERT/ERNIE recipes
+that the reference's fleet stack exists to train; SURVEY §6 configs)."""
+
+from .gpt import GPTConfig, GPTForCausalLM, GPTPretrainingCriterion  # noqa: F401
+from .bert import BertConfig, BertForPretraining, BertModel  # noqa: F401

@@ -1,0 +1,319 @@
+"""GPT — the flagship hybrid-parallel model.
+
+Reference capability anchor: the GPT-3 recipes trained by the reference's
+Fleet stack (SURVEY §3.4, §6 — 1.3B/6.7B, TP×PP×DP×sharding), model code
+per-op equivalent to paddlenlp GPT (fused attention + FFN blocks).
+
+TPU-native design decisions:
+- **scan-over-layers**: transformer blocks are ONE set of parameters stacked
+  on a leading [L] axis, iterated with lax.scan — constant compile time in
+  depth, and the natural representation for both remat and pipeline stages.
+- **TP/SP/EP via PartitionSpecs**: qkv/fc1 column-sharded, proj/fc2
+  row-sharded over 'mp'; activations sequence-sharded over 'sep' (Megatron
+  SP); MoE experts sharded over the data axis (EP).  GSPMD inserts the
+  psum/all-gather/all-to-all the reference implements as mp_ops/global_scatter.
+- **PP via distributed.pipeline**: stacked layers reshape to [pp, L/pp, ...]
+  and stream through the collective-permute schedule.
+- **flash attention**: Pallas kernel on TPU (kernels/flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.dispatch import apply_op, matmul_precision
+from ..core.tensor import Parameter, Tensor
+from ..distributed.env import get_mesh, hybrid_degrees
+from ..distributed.sharding_utils import annotate_param
+from ..kernels.flash_attention import flash_attention_fwd, reference_attention
+from ..kernels.rope import rope_tables
+from ..nn.layer.layers import Layer
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, max_seq_len=1024, ffn_hidden_size=None,
+                 dropout=0.0, attention_dropout=0.0, use_rope=False,
+                 layer_norm_epsilon=1e-5, initializer_range=0.02,
+                 use_flash_attention=True, recompute=False,
+                 sequence_parallel=False, num_experts=0, moe_every=2,
+                 moe_top_k=2, dtype="float32", tie_word_embeddings=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.max_seq_len = max_seq_len
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.dropout = dropout
+        self.attention_dropout = attention_dropout
+        self.use_rope = use_rope
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.initializer_range = initializer_range
+        self.use_flash_attention = use_flash_attention
+        self.recompute = recompute
+        self.sequence_parallel = sequence_parallel
+        self.num_experts = num_experts
+        self.moe_every = moe_every
+        self.moe_top_k = moe_top_k
+        self.dtype = dtype
+        self.tie_word_embeddings = tie_word_embeddings
+
+    # named sizes from the GPT-3 paper / reference recipes
+    @staticmethod
+    def gpt3_125m(**kw):
+        return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+    @staticmethod
+    def gpt3_350m(**kw):
+        return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+    @staticmethod
+    def gpt3_1_3b(**kw):
+        return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16, **kw)
+
+    @staticmethod
+    def gpt3_6_7b(**kw):
+        return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32, **kw)
+
+
+def _norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    return ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = c = config
+        import numpy as np
+        from ..nn.initializer import Normal, Constant
+        from ..nn.functional.init_utils import param_attr_init
+        H, L, V, S = c.hidden_size, c.num_layers, c.vocab_size, c.max_seq_len
+        F = c.ffn_hidden_size
+        init = Normal(0.0, c.initializer_range)
+        zeros = Constant(0.0)
+        ones = Constant(1.0)
+        dt = c.dtype
+
+        def mk(shape, ini, spec):
+            p = param_attr_init(shape, jnp.dtype(dt), None, False, ini)
+            annotate_param(p, spec)
+            return p
+
+        self.wte = mk((V, H), init, P("mp", None))
+        if not c.use_rope:
+            self.wpe = mk((S, H), init, P())
+        self.ln1_w = mk((L, H), ones, P())
+        self.ln1_b = mk((L, H), zeros, P())
+        self.qkv_w = mk((L, H, 3 * H), init, P(None, None, "mp"))
+        self.qkv_b = mk((L, 3 * H), zeros, P(None, "mp"))
+        self.proj_w = mk((L, H, H), init, P(None, "mp", None))
+        self.proj_b = mk((L, H), zeros, P())
+        self.ln2_w = mk((L, H), ones, P())
+        self.ln2_b = mk((L, H), zeros, P())
+        if c.num_experts > 0:
+            E = c.num_experts
+            self.gate_w = mk((L, H, E), init, P())
+            self.fc1_w = mk((L, E, H, F), init, P(None, "dp", None, "mp"))
+            self.fc1_b = mk((L, E, F), zeros, P(None, "dp", "mp"))
+            self.fc2_w = mk((L, E, F, H), init, P(None, "dp", "mp", None))
+            self.fc2_b = mk((L, E, H), zeros, P(None, "dp", None))
+        else:
+            self.fc1_w = mk((L, H, F), init, P(None, None, "mp"))
+            self.fc1_b = mk((L, F), zeros, P(None, "mp"))
+            self.fc2_w = mk((L, F, H), init, P(None, "mp", None))
+            self.fc2_b = mk((L, H), zeros, P())
+        self.lnf_w = mk((H,), ones, P())
+        self.lnf_b = mk((H,), zeros, P())
+        if not c.tie_word_embeddings:
+            self.lm_head = mk((H, V), init, P(None, "mp"))
+
+    # -- pure block ----------------------------------------------------------
+    def _block_fn(self, c, training, dkey):
+        eps = c.layer_norm_epsilon
+        nh = c.num_heads
+        use_flash = c.use_flash_attention
+
+        def attention(h, lw):
+            b, s, H = h.shape
+            hd = H // nh
+            qkv = jnp.matmul(h, lw["qkv_w"], precision=matmul_precision()) \
+                + lw["qkv_b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, s, nh, hd)
+            k = k.reshape(b, s, nh, hd)
+            v = v.reshape(b, s, nh, hd)
+            if c.use_rope:
+                from ..kernels.rope import apply_rope
+                q = apply_rope(q)
+                k = apply_rope(k)
+            if use_flash:
+                o = flash_attention_fwd(q, k, v, causal=True)
+            else:
+                o = reference_attention(q, k, v, causal=True)
+            o = o.reshape(b, s, H)
+            return jnp.matmul(o, lw["proj_w"], precision=matmul_precision()) \
+                + lw["proj_b"]
+
+        def ffn(h, lw):
+            if c.num_experts > 0:
+                # dense MoE dispatch (EP): experts stacked on an axis sharded
+                # over the data dim; GSPMD turns the einsum into all-to-all
+                logits = jnp.matmul(h, lw["gate_w"])  # [b,s,E]
+                probs = jax.nn.softmax(logits, -1)
+                k = min(c.moe_top_k, c.num_experts)
+                topv, topi = jax.lax.top_k(probs, k)
+                topv = topv / jnp.sum(topv, -1, keepdims=True)
+                gates = jnp.zeros_like(probs)
+                gates = jnp.put_along_axis(gates, topi, topv, axis=-1,
+                                           inplace=False)
+                up = jnp.einsum("bsh,ehf->bsef", h, lw["fc1_w"],
+                                precision=matmul_precision()) + lw["fc1_b"]
+                act = jax.nn.gelu(up)
+                down = jnp.einsum("bsef,efh->bseh", act, lw["fc2_w"],
+                                  precision=matmul_precision()) + lw["fc2_b"]
+                return jnp.einsum("bseh,bse->bsh", down, gates)
+            up = jnp.matmul(h, lw["fc1_w"], precision=matmul_precision()) \
+                + lw["fc1_b"]
+            act = jax.nn.gelu(up)
+            return jnp.matmul(act, lw["fc2_w"], precision=matmul_precision()) \
+                + lw["fc2_b"]
+
+        drop = c.dropout if training else 0.0
+
+        def block(h, lw_and_key):
+            lw, key = lw_and_key
+            x = _norm(h, lw["ln1_w"], lw["ln1_b"], eps)
+            a = attention(x, lw)
+            if drop > 0:
+                key, k1 = jax.random.split(key)
+                a = jnp.where(jax.random.bernoulli(k1, 1 - drop, a.shape),
+                              a / (1 - drop), 0.0).astype(a.dtype)
+            h = h + a
+            x = _norm(h, lw["ln2_w"], lw["ln2_b"], eps)
+            f = ffn(x, lw)
+            if drop > 0:
+                key, k2 = jax.random.split(key)
+                f = jnp.where(jax.random.bernoulli(k2, 1 - drop, f.shape),
+                              f / (1 - drop), 0.0).astype(f.dtype)
+            h = h + f
+            if c.sequence_parallel:
+                mesh = get_mesh()
+                if mesh is not None and isinstance(h, jax.core.Tracer):
+                    h = jax.lax.with_sharding_constraint(
+                        h, jax.sharding.NamedSharding(
+                            mesh, P(("dp", "sharding"), "sep", None)))
+            return h
+
+        return block
+
+    def _stacked(self):
+        names = ["ln1_w", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
+                 "ln2_w", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b"]
+        if self.config.num_experts > 0:
+            names.append("gate_w")
+        return names
+
+    def forward(self, input_ids, position_ids=None):
+        c = self.config
+        training = self.training
+        names = self._stacked()
+        params = [getattr(self, n) for n in names]
+        from ..tensor.random import _next_key
+        dkey = _next_key() if (training and c.dropout > 0) else None
+        pp = hybrid_degrees().get("pp", 1)
+
+        def fn(ids, wte, lnf_w, lnf_b, *rest, head_w=None):
+            L = c.num_layers
+            if c.use_rope:
+                wpe = None
+                stacked = rest
+            else:
+                wpe = rest[0]
+                stacked = rest[1:]
+            lws = dict(zip(names, stacked))
+            h = jnp.take(wte, ids, axis=0)
+            if wpe is not None:
+                pos = jnp.arange(ids.shape[1])
+                h = h + jnp.take(wpe, pos, axis=0)
+            block = self._block_fn(c, training, dkey)
+            keys = (jax.random.split(dkey, L) if dkey is not None
+                    else jnp.zeros((L, 2), jnp.uint32))
+
+            if pp > 1:
+                from ..distributed.pipeline import pipeline_apply
+                lpp = L // pp
+
+                def stage_fn(sp, hh):
+                    def body(hh, lw):
+                        return block(hh, (lw, dkey)), None
+                    hh, _ = jax.lax.scan(body, hh, sp)
+                    return hh
+                stage_params = {n: v.reshape(pp, lpp, *v.shape[1:])
+                                for n, v in lws.items()}
+                M = max(2 * pp, 1)
+                # microbatches must divide batch
+                while ids.shape[0] % M != 0 and M > 1:
+                    M -= 1
+                h = pipeline_apply(stage_fn, stage_params, h, M,
+                                   remat=c.recompute or True)
+            else:
+                def body(hh, xs):
+                    lw, key = xs
+                    return block(hh, (lw, key)), None
+                scan_body = body
+                if c.recompute:
+                    scan_body = jax.checkpoint(body)
+                h, _ = jax.lax.scan(scan_body, h, (lws, keys))
+            h = _norm(h, lnf_w, lnf_b, c.layer_norm_epsilon)
+            if c.tie_word_embeddings:
+                logits = jnp.matmul(h, wte.T, precision=matmul_precision())
+            else:
+                logits = jnp.matmul(h, head_w,
+                                    precision=matmul_precision())
+            mesh = get_mesh()
+            if mesh is not None and isinstance(logits, jax.core.Tracer):
+                logits = jax.lax.with_sharding_constraint(
+                    logits, jax.sharding.NamedSharding(
+                        mesh, P(("dp", "sharding"), None, "mp")))
+            return logits
+
+        args = [input_ids, self.wte, self.lnf_w, self.lnf_b]
+        if not c.use_rope:
+            args.append(self.wpe)
+        args += params
+        if not c.tie_word_embeddings:
+            return apply_op("gpt_forward",
+                            lambda ids, wte, lw, lb, *st: fn(
+                                ids, wte, lw, lb, *st[:-1], head_w=st[-1]),
+                            *args, self.lm_head)
+        return apply_op("gpt_forward", fn, *args)
+
+
+class GPTPretrainingCriterion(Layer):
+    """Causal-LM loss (reference: paddlenlp GPTPretrainingCriterion —
+    ParallelCrossEntropy over vocab-sharded logits)."""
+
+    def __init__(self, config=None):
+        super().__init__()
+
+    def forward(self, logits, labels, loss_mask=None):
+        def fn(lg, lb, *mask):
+            lg = lg.astype(jnp.float32)
+            logp = jax.nn.log_softmax(lg, -1)
+            picked = jnp.take_along_axis(
+                logp, lb[..., None].astype(jnp.int32), -1)[..., 0]
+            loss = -picked
+            if mask:
+                m = mask[0].astype(jnp.float32)
+                return jnp.sum(loss * m) / jnp.maximum(jnp.sum(m), 1.0)
+            return jnp.mean(loss)
+        if loss_mask is not None:
+            return apply_op("gpt_loss", fn, logits, labels, loss_mask)
+        return apply_op("gpt_loss", fn, logits, labels)

@@ -1,0 +1,12 @@
+"""paddle_tpu.nn.functional (reference: python/paddle/nn/functional/)."""
+
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import (conv1d, conv1d_transpose, conv2d, conv2d_transpose,  # noqa: F401
+                   conv3d, conv3d_transpose)
+from .flash_attention import (flash_attention, flash_attn_unpadded,  # noqa: F401
+                              scaled_dot_product_attention, sparse_attention)
+from .loss import *  # noqa: F401,F403
+from .norm import (batch_norm, group_norm, instance_norm, layer_norm,  # noqa: F401
+                   local_response_norm, rms_norm, spectral_norm)
+from .pooling import *  # noqa: F401,F403

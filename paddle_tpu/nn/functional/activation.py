@@ -1,0 +1,211 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def relu(x, name=None):
+    return apply_op("relu", jax.nn.relu, _t(x))
+
+
+def relu_(x, name=None):
+    return x._inplace_assign(relu(x))
+
+
+def relu6(x, name=None):
+    return apply_op("relu6", jax.nn.relu6, _t(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda v: jax.nn.elu(v, alpha), _t(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op("selu",
+                    lambda v: scale * jnp.where(v > 0, v,
+                                                alpha * jnp.expm1(v)), _t(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda v: jax.nn.celu(v, alpha), _t(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu", lambda v: jax.nn.gelu(v, approximate=approximate),
+                    _t(x))
+
+
+def sigmoid(x, name=None):
+    return apply_op("sigmoid", jax.nn.sigmoid, _t(x))
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return apply_op("hardsigmoid",
+                    lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), _t(x))
+
+
+def hardswish(x, name=None):
+    return apply_op("hardswish",
+                    lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op("hardtanh", lambda v: jnp.clip(v, min, max), _t(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op("hardshrink",
+                    lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), _t(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "softshrink",
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.0)),
+        _t(x))
+
+
+def tanhshrink(x, name=None):
+    return apply_op("tanhshrink", lambda v: v - jnp.tanh(v), _t(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu",
+                    lambda v: jax.nn.leaky_relu(v, negative_slope), _t(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+    return apply_op("prelu", fn, _t(x), weight)
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=False, name=None):
+    from ...tensor.random import _next_key
+    if training:
+        x = _t(x)
+        a = jax.random.uniform(_next_key(), x._data.shape, jnp.float32, lower,
+                               upper).astype(x.dtype)
+        return apply_op("rrelu", lambda v: jnp.where(v >= 0, v, a * v), x)
+    mid = (lower + upper) / 2.0
+    return apply_op("rrelu", lambda v: jnp.where(v >= 0, v, mid * v), _t(x))
+
+
+def log_sigmoid(x, name=None):
+    return apply_op("log_sigmoid", jax.nn.log_sigmoid, _t(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis if axis >= 0 else axis + v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return apply_op("maxout", fn, _t(x))
+
+
+def silu(x, name=None):
+    return apply_op("silu", jax.nn.silu, _t(x))
+
+
+swish = silu
+
+
+def mish(x, name=None):
+    return apply_op("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)), _t(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtypes
+    dt = dtypes.convert_dtype(dtype)
+
+    def fn(v):
+        if dt is not None:
+            v = v.astype(dt)
+        return jax.nn.softmax(v, axis=axis)
+    return apply_op("softmax", fn, _t(x))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._inplace_assign(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtypes
+    dt = dtypes.convert_dtype(dtype)
+
+    def fn(v):
+        if dt is not None:
+            v = v.astype(dt)
+        return jax.nn.log_softmax(v, axis=axis)
+    return apply_op("log_softmax", fn, _t(x))
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply_op(
+        "softplus",
+        lambda v: jnp.where(beta * v > threshold, v,
+                            jax.nn.softplus(beta * v) / beta), _t(x))
+
+
+def softsign(x, name=None):
+    return apply_op("softsign", jax.nn.soft_sign, _t(x))
+
+
+def tanh(x, name=None):
+    return apply_op("tanh", jnp.tanh, _t(x))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op("thresholded_relu",
+                    lambda v: jnp.where(v > threshold, v, value), _t(x))
+
+
+def glu(x, axis=-1, name=None):
+    def fn(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return apply_op("glu", fn, _t(x))
+
+
+def swiglu(x, y=None, name=None):
+    """Fused SwiGLU (reference: python/paddle/incubate/nn/functional/swiglu.py).
+    XLA fuses this chain into one kernel on TPU."""
+    if y is not None:
+        return apply_op("swiglu", lambda a, b: jax.nn.silu(a) * b, _t(x), _t(y))
+
+    def fn(v):
+        a, b = jnp.split(v, 2, axis=-1)
+        return jax.nn.silu(a) * b
+    return apply_op("swiglu", fn, _t(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...tensor.random import _next_key
+    x = _t(x)
+    g = jax.random.gumbel(_next_key(), x._data.shape).astype(x.dtype)
+
+    def fn(v):
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            # straight-through estimator
+            onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis],
+                                    axis=axis, dtype=y.dtype)
+            return y + jax.lax.stop_gradient(onehot - y)
+        return y
+    return apply_op("gumbel_softmax", fn, x)

@@ -1,0 +1,310 @@
+"""Common functionals: linear, dropout, embedding, interpolate, one_hot...
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply_op, matmul_precision
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b).  Weight layout [in, out] as in the reference
+    (python/paddle/nn/functional/common.py linear); maps to one MXU matmul."""
+    if bias is None:
+        return apply_op("linear",
+                        lambda a, w: jnp.matmul(a, w,
+                                                precision=matmul_precision()),
+                        _t(x), weight)
+    return apply_op(
+        "linear",
+        lambda a, w, b: jnp.matmul(a, w, precision=matmul_precision()) + b,
+        _t(x), weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """Dropout with TP-deterministic keys (reference:
+    python/paddle/nn/functional/common.py dropout; parallel-deterministic
+    variant: fleet/layers/mpu/random.py:140)."""
+    from ...tensor.random import _next_key
+    if not training or p == 0:
+        return _t(x)
+    if p == 1:
+        return apply_op("dropout", lambda v: jnp.zeros_like(v), _t(x))
+    x = _t(x)
+    shape = list(x._data.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(_next_key(), 1.0 - p, tuple(shape))
+
+    def fn(v):
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+    return apply_op("dropout", fn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    from ...tensor.random import _next_key
+    if not training or p == 0:
+        return _t(x)
+    x = _t(x)
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(_next_key(), 1.0 - p, x._data.shape)
+    a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+
+    def fn(v):
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+    return apply_op("alpha_dropout", fn, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Embedding lookup — a gather feeding the MXU-free VPU path
+    (reference kernel: phi/kernels/gpu/embedding_kernel.cu)."""
+    def fn(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_op("embedding", fn, _t(x), weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return Tensor._wrap(jax.nn.one_hot(_t(x)._data, num_classes,
+                                       dtype=jnp.float32))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l, *pd):
+        k = l.shape[-1]
+        if pd:
+            return (1 - epsilon) * l + epsilon * pd[0]
+        return (1 - epsilon) * l + epsilon / k
+    if prior_dist is not None:
+        return apply_op("label_smooth", fn, _t(label), _t(prior_dist))
+    return apply_op("label_smooth", fn, _t(label))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...tensor.manipulation import pad as _pad
+    x = _t(x)
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in pad.numpy()]
+    if len(pad) == 2 * x.ndim:
+        return _pad(x, pad, mode, value)
+    # nn.functional.pad semantics: pad spatial dims per data_format
+    nd = x.ndim
+    k = len(pad) // 2
+    width = [(0, 0)] * nd
+    if data_format.endswith("C"):  # NHWC/NDHWC/NLC
+        spatial = list(range(1, nd - 1))
+    else:  # NCHW/NCDHW/NCL
+        spatial = list(range(2, nd))
+    spatial = spatial[-k:][::-1]
+    for i, dim in enumerate(spatial):
+        width[dim] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+    flat = []
+    for w in width:
+        flat += [w[0], w[1]]
+    return _pad(x, flat, mode, value)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """Resize (reference: nn/functional/common.py interpolate → interp kernels).
+    Uses jax.image.resize (XLA gather/convolution based)."""
+    x = _t(x)
+    nd = x.ndim
+    channel_last = data_format.endswith("C")
+    spatial_ndim = nd - 2
+    in_spatial = (x.shape[1:-1] if channel_last else x.shape[2:])
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.numpy()]
+        out_spatial = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                       for s in (size if isinstance(size, (list, tuple))
+                                 else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * spatial_ndim
+        out_spatial = [int(np.floor(s * f)) for s, f in zip(in_spatial, sf)]
+    if channel_last:
+        out_shape = (x.shape[0], *out_spatial, x.shape[-1])
+    else:
+        out_shape = (x.shape[0], x.shape[1], *out_spatial)
+    method = {"nearest": "nearest", "bilinear": "bilinear", "linear": "linear",
+              "trilinear": "trilinear", "bicubic": "cubic",
+              "area": "linear"}[mode]
+    if method == "trilinear":
+        method = "trilinear" if spatial_ndim == 3 else "bilinear"
+
+    def fn(v):
+        return jax.image.resize(v, out_shape, method=method)
+    return apply_op("interpolate", fn, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b,
+                         precision=matmul_precision())
+        if bi:
+            out = out + bi[0]
+        return out
+    if bias is not None:
+        return apply_op("bilinear", fn, _t(x1), _t(x2), weight, bias)
+    return apply_op("bilinear", fn, _t(x1), _t(x2), weight)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        d1 = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        d2 = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(d1 * d2, eps)
+    return apply_op("cosine_similarity", fn, _t(x1), _t(x2))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+    return apply_op("normalize", fn, _t(x))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference kernel: phi/kernels/impl/unfold_kernel_impl.h)."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pads = (p, p, p, p)
+    elif len(p) == 2:
+        pads = (p[0], p[0], p[1], p[1])
+    else:
+        pads = tuple(p)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (pads[0], pads[1]), (pads[2], pads[3])))
+        oh = (v.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (v.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            v, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * kh * kw, oh * ow)
+    return apply_op("unfold", fn, _t(x))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pads = (p, p, p, p)
+    elif len(p) == 2:
+        pads = (p[0], p[0], p[1], p[1])
+    else:
+        pads = tuple(p)
+
+    def fn(v):
+        n, ckk, l = v.shape
+        c = ckk // (kh * kw)
+        out = jnp.zeros((n, c, oh + pads[0] + pads[1], ow + pads[2] + pads[3]),
+                        v.dtype)
+        nh = (out.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (out.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+        v = v.reshape(n, c, kh, kw, nh, nw)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :,
+                             i * dh:i * dh + nh * sh:sh,
+                             j * dw:j * dw + nw * sw:sw].add(v[:, :, i, j])
+        return out[:, :, pads[0]:out.shape[2] - pads[1],
+                   pads[2]:out.shape[3] - pads[3]]
+    return apply_op("fold", fn, _t(x))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = jnp.transpose(v, (0, 1, 4, 2, 5, 3))
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = jnp.transpose(v, (0, 1, 3, 2, 4, 5))
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return apply_op("pixel_shuffle", fn, _t(x))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = jnp.transpose(v, (0, 1, 3, 5, 2, 4))
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = jnp.transpose(v, (0, 1, 3, 2, 4, 5))
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return apply_op("pixel_unshuffle", fn, _t(x))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            return jnp.swapaxes(v, 1, 2).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        return jnp.swapaxes(v, 3, 4).reshape(n, h, w, c)
+    return apply_op("channel_shuffle", fn, _t(x))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)

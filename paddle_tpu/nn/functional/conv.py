@@ -1,0 +1,156 @@
+"""Convolutions via lax.conv_general_dilated — XLA tiles these directly onto
+the MXU (reference kernels: phi/kernels/gpudnn/conv_kernel.cu)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply_op, matmul_precision
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in (list(v) * n)[:n]) if len(v) == 1 else \
+            tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _padding(padding, spatial, strides=None, dilations=None, ksize=None):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * spatial
+    padding = list(padding)
+    if len(padding) == spatial:
+        if isinstance(padding[0], (list, tuple)):
+            return [tuple(p) for p in padding]
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * spatial:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(spatial)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, spatial,
+          data_format, op_name):
+    strides = _ntuple(stride, spatial)
+    dilations = _ntuple(dilation, spatial)
+    pad = _padding(padding, spatial)
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        ln = "NC" + "DHW"[3 - spatial:]
+        dn = (ln, "OI" + "DHW"[3 - spatial:], ln)
+    else:
+        ln = "N" + "DHW"[3 - spatial:] + "C"
+        dn = (ln, "OI" + "DHW"[3 - spatial:], ln)
+
+    def fn(v, w, *b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, feature_group_count=groups,
+            dimension_numbers=dn, precision=matmul_precision())
+        if b:
+            if ln.endswith("C"):
+                out = out + b[0].reshape((1,) * (out.ndim - 1) + (-1,))
+            else:
+                out = out + b[0].reshape((1, -1) + (1,) * spatial)
+        return out
+    if bias is not None:
+        return apply_op(op_name, fn, _t(x), weight, bias)
+    return apply_op(op_name, fn, _t(x), weight)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, spatial, data_format, op_name,
+                    output_size=None):
+    strides = _ntuple(stride, spatial)
+    dilations = _ntuple(dilation, spatial)
+    opad = _ntuple(output_padding, spatial)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    pads = _padding(padding, spatial)
+    ln = ("NC" + "DHW"[3 - spatial:]) if data_format.startswith("NC") \
+        else ("N" + "DHW"[3 - spatial:] + "C")
+    dn = (ln, "IO" + "DHW"[3 - spatial:], ln)
+
+    # transposed conv = lhs-dilated conv; padding transform: k-1-p
+    def fn(v, w, *b):
+        kdims = w.shape[2:]
+        tpads = [(dilations[i] * (kdims[i] - 1) - pads[i][0],
+                  dilations[i] * (kdims[i] - 1) - pads[i][1] + opad[i])
+                 for i in range(spatial)]
+        # weight layout paddle: [in, out/groups, *k] = IO layout
+        w_flip = jnp.flip(w, axis=tuple(range(2, 2 + spatial)))
+        if groups > 1:
+            ic = w.shape[0]
+            ws = jnp.split(w_flip, groups, axis=0)
+            vs = jnp.split(v, groups, axis=1 if ln.startswith("NC") else -1)
+            outs = [jax.lax.conv_general_dilated(
+                vi, jnp.swapaxes(wi, 0, 1), window_strides=(1,) * spatial,
+                padding=tpads, lhs_dilation=strides, rhs_dilation=dilations,
+                dimension_numbers=(ln, "OI" + "DHW"[3 - spatial:], ln),
+                precision=matmul_precision()) for vi, wi in zip(vs, ws)]
+            out = jnp.concatenate(outs, axis=1 if ln.startswith("NC") else -1)
+        else:
+            out = jax.lax.conv_general_dilated(
+                v, jnp.swapaxes(w_flip, 0, 1), window_strides=(1,) * spatial,
+                padding=tpads, lhs_dilation=strides, rhs_dilation=dilations,
+                dimension_numbers=(ln, "OI" + "DHW"[3 - spatial:], ln),
+                precision=matmul_precision())
+        if b:
+            if ln.endswith("C"):
+                out = out + b[0].reshape((1,) * (out.ndim - 1) + (-1,))
+            else:
+                out = out + b[0].reshape((1, -1) + (1,) * spatial)
+        return out
+    if bias is not None:
+        return apply_op(op_name, fn, _t(x), weight, bias)
+    return apply_op(op_name, fn, _t(x), weight)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format,
+                           "conv1d_transpose", output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format,
+                           "conv2d_transpose", output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format,
+                           "conv3d_transpose", output_size)

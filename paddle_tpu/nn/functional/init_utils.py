@@ -1,0 +1,53 @@
+"""ParamAttr handling + parameter creation shared by layers."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Parameter
+
+_GLOBAL_WEIGHT_INIT = [None]
+_GLOBAL_BIAS_INIT = [None]
+
+
+class ParamAttr:
+    """reference: python/paddle/base/param_attr.py ParamAttr."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+def param_attr_init(shape, dtype, attr, is_bias, default_initializer):
+    from ..initializer import Constant, XavierUniform
+
+    shape = tuple(int(s) for s in shape)
+    init = None
+    name = None
+    trainable = True
+    if isinstance(attr, ParamAttr):
+        init = attr.initializer
+        name = attr.name
+        trainable = attr.trainable
+    elif callable(attr):
+        init = attr
+    if init is None:
+        init = default_initializer
+    if init is None:
+        glob = _GLOBAL_BIAS_INIT[0] if is_bias else _GLOBAL_WEIGHT_INIT[0]
+        init = glob
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierUniform()
+    data = init(shape, dtype)
+    p = Parameter(data, name=name, trainable=trainable)
+    if isinstance(attr, ParamAttr):
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+    return p

@@ -1,0 +1,396 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """reference: python/paddle/nn/functional/loss.py cross_entropy
+    (softmax_with_cross_entropy kernel, phi/kernels/gpu/cross_entropy_kernel.cu)."""
+    input, label = _t(input), _t(label)
+
+    def fn(logits, lab, *w):
+        ax = axis if axis >= 0 else logits.ndim + axis
+        logp = (jax.nn.log_softmax(logits, axis=ax) if use_softmax
+                else jnp.log(jnp.maximum(logits, 1e-30)))
+        n_class = logits.shape[ax]
+        if soft_label or (lab.ndim == logits.ndim and lab.shape[ax] == n_class
+                          and jnp.issubdtype(lab.dtype, jnp.floating)):
+            soft = lab.astype(logp.dtype)
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_class
+            loss = -jnp.sum(soft * logp, axis=ax)
+        else:
+            li = lab
+            if li.ndim == logits.ndim:
+                li = jnp.squeeze(li, ax)
+            li = li.astype(jnp.int32)
+            valid = li != ignore_index
+            safe = jnp.where(valid, li, 0)
+            picked = jnp.take_along_axis(logp, safe[..., None].astype(jnp.int32)
+                                         if ax == logits.ndim - 1 else
+                                         jnp.expand_dims(safe, ax), axis=ax)
+            picked = jnp.squeeze(picked, ax)
+            if label_smoothing > 0:
+                smooth_loss = -jnp.mean(logp, axis=ax)
+                loss = -(1 - label_smoothing) * picked + \
+                    label_smoothing * smooth_loss
+            else:
+                loss = -picked
+            if w:
+                loss = loss * jnp.take(w[0], safe)
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                denom = (jnp.sum(jnp.take(w[0], safe) * valid) if w
+                         else jnp.sum(valid))
+                return jnp.sum(loss) / jnp.maximum(denom, 1)
+        return _reduce(loss, reduction)
+    if weight is not None:
+        return apply_op("cross_entropy", fn, input, label, weight)
+    return apply_op("cross_entropy", fn, input, label)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    # paddle returns shape with trailing 1 on the class axis
+    from .activation import softmax as _softmax
+    from ...tensor.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def fn(logp, lab, *w):
+        lab = lab.astype(jnp.int32)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0] \
+            if logp.ndim == 2 else \
+            jnp.squeeze(jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), 1), 1)
+        loss = -picked
+        wt = jnp.take(w[0], safe) if w else jnp.ones_like(loss)
+        loss = jnp.where(valid, loss * wt, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(wt * valid), 1e-12)
+        return _reduce(loss, reduction)
+    if weight is not None:
+        return apply_op("nll_loss", fn, _t(input), _t(label), weight)
+    return apply_op("nll_loss", fn, _t(input), _t(label))
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op("mse_loss",
+                    lambda a, b: _reduce(jnp.square(a - b), reduction),
+                    _t(input), _t(label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op("l1_loss",
+                    lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    _t(input), _t(label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta,
+                         jnp.abs(d) - 0.5 * delta) * delta
+        # paddle: huber variant with delta multiplier folded
+        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d,
+                         delta * (jnp.abs(d) - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply_op("smooth_l1_loss", fn, _t(input), _t(label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def fn(p, y, *w):
+        eps = 1e-12
+        loss = -(y * jnp.log(jnp.maximum(p, eps))
+                 + (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    if weight is not None:
+        return apply_op("bce", fn, _t(input), _t(label), weight)
+    return apply_op("bce", fn, _t(input), _t(label))
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def fn(z, y, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        # numerically stable
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [a for a in (weight, pos_weight) if a is not None]
+    return apply_op("bce_with_logits", fn, _t(logit), _t(label), *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = t * (jnp.log(jnp.maximum(t, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op("kl_div", fn, _t(input), _t(label))
+
+
+def square_error_cost(input, label):
+    return apply_op("square_error_cost", lambda a, b: jnp.square(a - b),
+                    _t(input), _t(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply_op(
+        "margin_ranking_loss",
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin),
+                                reduction),
+        _t(input), _t(other), _t(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def fn(a, b, y):
+        sim = jnp.sum(a * b, -1) / (jnp.linalg.norm(a, axis=-1)
+                                    * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(y == 1, 1 - sim, jnp.maximum(0.0, sim - margin))
+        return _reduce(loss, reduction)
+    return apply_op("cosine_embedding_loss", fn, _t(input1), _t(input2),
+                    _t(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, -1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, -1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, -1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return apply_op("triplet_margin_loss", fn, _t(input), _t(positive),
+                    _t(negative))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn2 = distance_function(positive, negative)
+        from ...tensor.math import minimum
+        dn = minimum(dn, dn2)
+    from ...tensor.math import clip
+    loss = clip(dp - dn + margin, min=0.0)
+    from ...tensor.math import mean as _mean, sum as _sum
+    return _mean(loss) if reduction == "mean" else (
+        _sum(loss) if reduction == "sum" else loss)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply_op(
+        "hinge_embedding_loss",
+        lambda x, y: _reduce(jnp.where(y == 1, x,
+                                       jnp.maximum(0.0, margin - x)), reduction),
+        _t(input), _t(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        pt = p * y + (1 - p) * (1 - y)
+        at = alpha * y + (1 - alpha) * (1 - y)
+        loss = at * ((1 - pt) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    if normalizer is not None:
+        return apply_op("sigmoid_focal_loss", fn, _t(logit), _t(label),
+                        normalizer)
+    return apply_op("sigmoid_focal_loss", fn, _t(logit), _t(label))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def fn(p, y):
+        y1 = jax.nn.one_hot(jnp.squeeze(y, -1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op("dice_loss", fn, _t(input), _t(label))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def fn(x, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        loss = jnp.mean(loss, -1)
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    if weight is not None:
+        return apply_op("multi_label_soft_margin_loss", fn, _t(input),
+                        _t(label), weight)
+    return apply_op("multi_label_soft_margin_loss", fn, _t(input), _t(label))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        "soft_margin_loss",
+        lambda x, y: _reduce(jnp.log1p(jnp.exp(-y * x)), reduction),
+        _t(input), _t(label))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(loss, reduction)
+    return apply_op("gaussian_nll_loss", fn, _t(input), _t(label), _t(variance))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply_op("poisson_nll_loss", fn, _t(input), _t(label))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space
+    (reference: warpctc third_party dep; here a lax.scan DP — compiler-friendly
+    on TPU)."""
+    lp = _t(log_probs)  # [T, B, C] paddle layout
+    lab = _t(labels)    # [B, S]
+
+    def fn(logp, lbl, in_len, lab_len):
+        T, B, C = logp.shape
+        S = lbl.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        L = 2 * S + 1
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, L), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+        first_lab = jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(first_lab)
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), dtype=bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, logp_t):
+            a = alpha
+            a1 = jnp.concatenate([jnp.full((B, 1), neg_inf), a[:, :-1]], 1)
+            a2 = jnp.concatenate([jnp.full((B, 2), neg_inf), a[:, :-2]], 1)
+            a2 = jnp.where(same_as_prev2, neg_inf, a2)
+            m = jnp.maximum(jnp.maximum(a, a1), a2)
+            m_safe = jnp.where(m == neg_inf, 0.0, m)
+            s = (jnp.exp(a - m_safe) + jnp.exp(a1 - m_safe)
+                 + jnp.exp(a2 - m_safe))
+            new = jnp.where(m == neg_inf, neg_inf, m_safe + jnp.log(s))
+            emit = jnp.take_along_axis(logp_t, ext, axis=1)
+            return new + emit, None
+
+        alphaT, _ = jax.lax.scan(step, alpha0, logp[1:])
+        # pick final two states at position 2*lab_len-1 and 2*lab_len
+        idx_last = 2 * lab_len.astype(jnp.int32)
+        aT = alphaT
+        v1 = jnp.take_along_axis(aT, idx_last[:, None], 1)[:, 0]
+        v2 = jnp.take_along_axis(aT, jnp.maximum(idx_last - 1, 0)[:, None], 1)[:, 0]
+        m = jnp.maximum(v1, v2)
+        ll = m + jnp.log(jnp.exp(v1 - m) + jnp.exp(v2 - m))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1))
+        return _reduce(loss, reduction)
+    return apply_op("ctc_loss", fn, lp, lab, _t(input_lengths),
+                    _t(label_lengths))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, y):
+        sim = a @ p.T
+        B = a.shape[0]
+        eq = (y[:, None] == y[None, :]).astype(sim.dtype)
+        eq = eq / jnp.sum(eq, axis=1, keepdims=True)
+        xent = -jnp.sum(eq * jax.nn.log_softmax(sim, axis=1), axis=1)
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1))
+                        + jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return jnp.mean(xent) + reg
+    return apply_op("npair_loss", fn, _t(anchor), _t(positive), _t(labels))
+
+
+def mv_loss(*args, **kwargs):
+    raise NotImplementedError

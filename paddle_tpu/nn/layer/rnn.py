@@ -1,0 +1,292 @@
+"""RNN layers over lax.scan (reference: python/paddle/nn/layer/rnn.py; CUDA
+used cuDNN RNN kernels — on TPU a lax.scan over fused cell matmuls is the
+idiomatic lowering, keeping the whole unroll inside one XLA while-loop)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from ..functional.init_utils import param_attr_init
+from ..initializer import Uniform
+from .layers import Layer, LayerList
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        from ...tensor.creation import full
+        return full([b, self.hidden_size], init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = param_attr_init((hidden_size, input_size), self._dtype,
+                                         weight_ih_attr, False, init)
+        self.weight_hh = param_attr_init((hidden_size, hidden_size),
+                                         self._dtype, weight_hh_attr, False, init)
+        self.bias_ih = param_attr_init((hidden_size,), self._dtype,
+                                       bias_ih_attr, True, init)
+        self.bias_hh = param_attr_init((hidden_size,), self._dtype,
+                                       bias_hh_attr, True, init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = apply_op("simple_rnn_cell", fn, inputs, states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,),)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = param_attr_init((4 * hidden_size, input_size),
+                                         self._dtype, weight_ih_attr, False,
+                                         init)
+        self.weight_hh = param_attr_init((4 * hidden_size, hidden_size),
+                                         self._dtype, weight_hh_attr, False,
+                                         init)
+        self.bias_ih = param_attr_init((4 * hidden_size,), self._dtype,
+                                       bias_ih_attr, True, init)
+        self.bias_hh = param_attr_init((4 * hidden_size,), self._dtype,
+                                       bias_hh_attr, True, init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ...tensor.creation import zeros
+            b = inputs.shape[0]
+            states = (zeros([b, self.hidden_size]), zeros([b, self.hidden_size]))
+        h0, c0 = states
+
+        def fn(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, c_new
+        h, c = apply_op("lstm_cell", fn, inputs, h0, c0, self.weight_ih,
+                        self.weight_hh, self.bias_ih, self.bias_hh, nout=2)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = param_attr_init((3 * hidden_size, input_size),
+                                         self._dtype, weight_ih_attr, False,
+                                         init)
+        self.weight_hh = param_attr_init((3 * hidden_size, hidden_size),
+                                         self._dtype, weight_hh_attr, False,
+                                         init)
+        self.bias_ih = param_attr_init((3 * hidden_size,), self._dtype,
+                                       bias_ih_attr, True, init)
+        self.bias_hh = param_attr_init((3 * hidden_size,), self._dtype,
+                                       bias_hh_attr, True, init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, -1)
+            hr, hz, hn = jnp.split(hg, 3, -1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+        h = apply_op("gru_cell", fn, inputs, states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,),)
+
+
+class RNN(Layer):
+    """Run a cell over time via lax.scan (reference: nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import stack, transpose, unstack
+        x = inputs
+        if not self.time_major:
+            x = transpose(x, [1, 0, 2])
+        steps = unstack(x, axis=0)
+        if self.is_reverse:
+            steps = steps[::-1]
+        states = initial_states
+        outs = []
+        for s in steps:
+            o, states = self.cell(s, states)
+            outs.append(o)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = stack(outs, axis=0)
+        if not self.time_major:
+            out = transpose(out, [1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        o1, f1 = self.rnn_fw(inputs, s_fw)
+        o2, f2 = self.rnn_bw(inputs, s_bw)
+        return concat([o1, o2], axis=-1), (f1, f2)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, proj_size=0):
+        super().__init__()
+        self.mode = mode
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        kw = dict(weight_ih_attr=weight_ih_attr, weight_hh_attr=weight_hh_attr,
+                  bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+        Cell = {"LSTM": LSTMCell, "GRU": GRUCell,
+                "RNN_TANH": SimpleRNNCell, "RNN_RELU": SimpleRNNCell}[mode]
+
+        def mk(in_sz):
+            if mode == "RNN_RELU":
+                return Cell(in_sz, hidden_size, activation="relu", **kw)
+            if mode == "RNN_TANH":
+                return Cell(in_sz, hidden_size, activation="tanh", **kw)
+            return Cell(in_sz, hidden_size, **kw)
+
+        layers = []
+        for i in range(num_layers):
+            in_sz = input_size if i == 0 else hidden_size * self.num_directions
+            if bidirect:
+                layers.append(BiRNN(mk(in_sz), mk(in_sz), time_major))
+            else:
+                layers.append(RNN(mk(in_sz), False, time_major))
+        self.rnns = LayerList(layers)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as Fm
+        out = inputs
+        finals = []
+        for i, rnn in enumerate(self.rnns):
+            out, st = rnn(out)
+            finals.append(st)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                out = Fm.dropout(out, self.dropout, training=self.training)
+        # pack final states like paddle: [num_layers*num_directions, B, H]
+        from ...tensor.manipulation import stack
+
+        def flat(sts):
+            res = []
+            for s in sts:
+                if isinstance(s, tuple) and len(s) == 2 and isinstance(
+                        s[0], (tuple, Tensor)):
+                    if isinstance(s[0], tuple):  # BiRNN of LSTM
+                        res.extend([s[0], s[1]])
+                    else:
+                        res.append(s)
+                else:
+                    res.append(s)
+            return res
+        if self.mode == "LSTM":
+            hs, cs = [], []
+            for st in finals:
+                items = [st] if not isinstance(st, tuple) or isinstance(
+                    st[0], Tensor) else list(st)
+                # each item is (h, c)
+                if isinstance(st, tuple) and isinstance(st[0], tuple):
+                    for sub in st:
+                        hs.append(sub[0])
+                        cs.append(sub[1])
+                else:
+                    hs.append(st[0])
+                    cs.append(st[1])
+            return out, (stack(hs, 0), stack(cs, 0))
+        hs = []
+        for st in finals:
+            if isinstance(st, tuple):
+                hs.extend(list(st))
+            else:
+                hs.append(st)
+        return out, stack(hs, 0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 proj_size=0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)

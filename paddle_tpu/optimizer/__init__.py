@@ -1,0 +1,596 @@
+"""Optimizers (reference: python/paddle/optimizer/ — Optimizer base
+optimizer.py, fused per-param kernels e.g. adamw `_C_ops.adamw_`).
+
+TPU-native: each optimizer's update math is pure jnp on device arrays, so a
+whole ``opt.step()`` traces into the jitted train step (the analogue of the
+reference's fused multi-tensor CUDA kernels — XLA fuses the update chain).
+Multi-precision (fp32 master weights for bf16/fp16 params) follows
+``multi_precision=True`` in the reference kernels (phi ops.yaml adamw)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.state import no_grad_guard
+from ..core.tensor import Parameter, Tensor
+from . import lr  # noqa: F401
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                # param groups
+                self._param_groups = parameters
+                flat = []
+                for g in parameters:
+                    flat.extend(g["params"])
+                parameters = flat
+            else:
+                self._param_groups = None
+        else:
+            self._param_groups = None
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, float):
+            self._weight_decay = weight_decay
+        elif weight_decay is None:
+            self._weight_decay = 0.0
+        else:  # L2Decay object
+            self._weight_decay = getattr(weight_decay, "_coeff",
+                                         getattr(weight_decay, "coeff", 0.0))
+        self._accumulators: dict[str, dict[int, jnp.ndarray]] = {}
+        self._master_weights: dict[int, jnp.ndarray] = {}
+        self._step_count = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return self._learning_rate
+
+    def set_lr(self, value):
+        self._learning_rate = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    def _param_lr(self, p):
+        return getattr(p, "optimize_attr", {}).get("learning_rate", 1.0) \
+            if hasattr(p, "optimize_attr") else 1.0
+
+    # -- accumulators --------------------------------------------------------
+    def _acc(self, name, p, init=None):
+        store = self._accumulators.setdefault(name, {})
+        if id(p) not in store:
+            store[id(p)] = (jnp.zeros_like(self._master(p)) if init is None
+                            else init)
+        return store[id(p)]
+
+    def _set_acc(self, name, p, value):
+        self._accumulators[name][id(p)] = value
+
+    def _master(self, p):
+        """fp32 master weight for low-precision params."""
+        if not self._multi_precision or p._data.dtype == jnp.float32:
+            return p._data
+        if id(p) not in self._master_weights:
+            self._master_weights[id(p)] = p._data.astype(jnp.float32)
+        return self._master_weights[id(p)]
+
+    def _write_back(self, p, new_master):
+        if self._multi_precision and p._data.dtype != jnp.float32:
+            self._master_weights[id(p)] = new_master
+            p._data = new_master.astype(p._data.dtype)
+        else:
+            p._data = new_master.astype(p._data.dtype)
+
+    # -- step ----------------------------------------------------------------
+    def _collect_params_grads(self):
+        pg = []
+        for p in self._parameter_list:
+            if p is None or p.stop_gradient:
+                continue
+            g = p.grad
+            if g is None:
+                continue
+            pg.append((p, g))
+        return pg
+
+    def step(self):
+        with no_grad_guard():
+            pg = self._collect_params_grads()
+            if self._grad_clip is not None:
+                pg = self._grad_clip(pg)
+            self._step_count += 1
+            for p, g in pg:
+                self._update_param(p, g._data.astype(jnp.float32)
+                                   if self._multi_precision else g._data)
+
+    def _update_param(self, p, g):
+        raise NotImplementedError
+
+    @property
+    def _lr(self):
+        return self.get_lr()
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            if p is not None:
+                p.clear_gradient(set_to_zero=False)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self):
+        names = {id(p): (p.name or f"param_{i}")
+                 for i, p in enumerate(self._parameter_list or [])}
+        out = {"master_weights": {}, "LR_Scheduler": {}, "accumulators": {},
+               "step": self._step_count}
+        for accname, store in self._accumulators.items():
+            out["accumulators"][accname] = {
+                names.get(pid, str(pid)): np.asarray(v)
+                for pid, v in store.items()}
+        for pid, v in self._master_weights.items():
+            out["master_weights"][names.get(pid, str(pid))] = np.asarray(v)
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        names = {(p.name or f"param_{i}"): p
+                 for i, p in enumerate(self._parameter_list or [])}
+        self._step_count = state.get("step", 0)
+        for accname, store in state.get("accumulators", {}).items():
+            dst = self._accumulators.setdefault(accname, {})
+            for pname, v in store.items():
+                if pname in names:
+                    dst[id(names[pname])] = jnp.asarray(np.asarray(v))
+        for pname, v in state.get("master_weights", {}).items():
+            if pname in names:
+                self._master_weights[id(names[pname])] = jnp.asarray(
+                    np.asarray(v))
+        if isinstance(self._learning_rate, LRScheduler) and \
+                state.get("LR_Scheduler"):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+
+    def _update_param(self, p, g):
+        m = self._master(p)
+        if self._weight_decay:
+            g = g + self._weight_decay * m
+        self._write_back(p, m - self._lr * self._param_lr(p) * g)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update_param(self, p, g):
+        m = self._master(p)
+        if self._weight_decay:
+            g = g + self._weight_decay * m
+        vel = self._acc("velocity", p)
+        vel = self._momentum * vel + g
+        self._set_acc("velocity", p, vel)
+        upd = (g + self._momentum * vel) if self._nesterov else vel
+        self._write_back(p, m - self._lr * self._param_lr(p) * upd)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+
+    def _moments(self, p, g):
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        b1p = self._acc("beta1_pow", p, jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, jnp.asarray(1.0, jnp.float32))
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        b1p = b1p * self._beta1
+        b2p = b2p * self._beta2
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        self._set_acc("beta1_pow", p, b1p)
+        self._set_acc("beta2_pow", p, b2p)
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        if self._amsgrad:
+            vmax = self._acc("moment2_max", p)
+            vmax = jnp.maximum(vmax, vhat)
+            self._set_acc("moment2_max", p, vmax)
+            vhat = vmax
+        return mhat, vhat
+
+    def _update_param(self, p, g):
+        master = self._master(p)
+        if self._weight_decay:  # Adam: L2 into grad
+            g = g + self._weight_decay * master
+        mhat, vhat = self._moments(p, g)
+        self._write_back(
+            p, master - self._lr * self._param_lr(p) * mhat
+            / (jnp.sqrt(vhat) + self._eps))
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: optimizer/adamw.py → adamw_ kernel)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad)
+        self._wd = weight_decay if isinstance(weight_decay, float) else \
+            getattr(weight_decay, "_coeff", 0.01)
+        self._apply_decay_fn = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update_param(self, p, g):
+        master = self._master(p)
+        lr = self._lr * self._param_lr(p)
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        decay = self._wd
+        if self._apply_decay_fn is not None and not self._apply_decay_fn(
+                p.name):
+            decay = 0.0
+        mhat, vhat = self._moments(p, g)
+        new = master * (1 - lr * decay) - lr * mhat / (jnp.sqrt(vhat)
+                                                       + self._eps)
+        self._write_back(p, new)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, g):
+        m = self._master(p)
+        if self._weight_decay:
+            g = g + self._weight_decay * m
+        acc = self._acc("moment", p,
+                        jnp.full_like(m, self._init_acc))
+        acc = acc + g * g
+        self._set_acc("moment", p, acc)
+        self._write_back(p, m - self._lr * self._param_lr(p) * g
+                         / (jnp.sqrt(acc) + self._eps))
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._eps, self._rho = epsilon, rho
+
+    def _update_param(self, p, g):
+        m = self._master(p)
+        if self._weight_decay:
+            g = g + self._weight_decay * m
+        avg_sq = self._acc("avg_squared_grad", p)
+        avg_upd = self._acc("avg_squared_update", p)
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * g * g
+        upd = -jnp.sqrt(avg_upd + self._eps) / jnp.sqrt(avg_sq + self._eps) * g
+        avg_upd = self._rho * avg_upd + (1 - self._rho) * upd * upd
+        self._set_acc("avg_squared_grad", p, avg_sq)
+        self._set_acc("avg_squared_update", p, avg_upd)
+        self._write_back(p, m + self._lr * self._param_lr(p) * upd)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update_param(self, p, g):
+        master = self._master(p)
+        if self._weight_decay:
+            g = g + self._weight_decay * master
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        b1p = self._acc("beta1_pow", p, jnp.asarray(1.0, jnp.float32))
+        m = self._beta1 * m + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * u, jnp.abs(g))
+        b1p = b1p * self._beta1
+        self._set_acc("moment", p, m)
+        self._set_acc("inf_norm", p, u)
+        self._set_acc("beta1_pow", p, b1p)
+        self._write_back(p, master - self._lr * self._param_lr(p)
+                         / (1 - b1p) * m / (u + self._eps))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._rho, self._eps = rho, epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _update_param(self, p, g):
+        m = self._master(p)
+        if self._weight_decay:
+            g = g + self._weight_decay * m
+        ms = self._acc("mean_square", p)
+        ms = self._rho * ms + (1 - self._rho) * g * g
+        self._set_acc("mean_square", p, ms)
+        if self._centered:
+            mg = self._acc("mean_grad", p)
+            mg = self._rho * mg + (1 - self._rho) * g
+            self._set_acc("mean_grad", p, mg)
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._acc("momentum", p)
+        mom = self._momentum * mom + self._lr * self._param_lr(p) * g / denom
+        self._set_acc("momentum", p, mom)
+        self._write_back(p, m - mom)
+
+
+class ASGD(Optimizer):
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._batch_num = batch_num
+
+    def _update_param(self, p, g):
+        m = self._master(p)
+        if self._weight_decay:
+            g = g + self._weight_decay * m
+        d = self._acc("d", p)
+        ys = self._acc("y", p)
+        d = d - ys + g
+        self._set_acc("d", p, d)
+        self._set_acc("y", p, g)
+        self._write_back(p, m - self._lr * self._param_lr(p)
+                         * d / self._batch_num)
+
+
+class NAdam(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _update_param(self, p, g):
+        master = self._master(p)
+        if self._weight_decay:
+            g = g + self._weight_decay * master
+        t = self._step_count
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = self._acc("mu_prod", p, jnp.asarray(1.0, jnp.float32))
+        mu_prod_new = mu_prod * mu_t
+        self._set_acc("mu_prod", p, mu_prod_new)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = (mu_t1 * m / (1 - mu_prod_new * mu_t1)
+                + (1 - mu_t) * g / (1 - mu_prod_new))
+        vhat = v / (1 - self._beta2 ** t)
+        self._write_back(p, master - self._lr * self._param_lr(p) * mhat
+                         / (jnp.sqrt(vhat) + self._eps))
+
+
+class RAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update_param(self, p, g):
+        master = self._master(p)
+        if self._weight_decay:
+            g = g + self._weight_decay * master
+        t = self._step_count
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._beta1 ** t)
+        rho_inf = 2 / (1 - self._beta2) - 1
+        rho_t = rho_inf - 2 * t * self._beta2 ** t / (1 - self._beta2 ** t)
+        lr = self._lr * self._param_lr(p)
+        if rho_t > 5:
+            vhat = jnp.sqrt(v / (1 - self._beta2 ** t))
+            r = (((rho_t - 4) * (rho_t - 2) * rho_inf)
+                 / ((rho_inf - 4) * (rho_inf - 2) * rho_t)) ** 0.5
+            self._write_back(p, master - lr * r * mhat / (vhat + self._eps))
+        else:
+            self._write_back(p, master - lr * mhat)
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _update_param(self, p, g):
+        m = self._master(p)
+        prev = self._acc("prev_grad", p)
+        step = self._acc("step_size", p,
+                         jnp.full_like(m, self._lr))
+        sign = jnp.sign(g * prev)
+        step = jnp.clip(jnp.where(sign > 0, step * self._etas[1],
+                                  jnp.where(sign < 0, step * self._etas[0],
+                                            step)),
+                        self._lr_range[0], self._lr_range[1])
+        g = jnp.where(sign < 0, 0.0, g)
+        self._set_acc("prev_grad", p, g)
+        self._set_acc("step_size", p, step)
+        self._write_back(p, m - jnp.sign(g) * step)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g):
+        master = self._master(p)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        b1p = self._acc("beta1_pow", p, jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, jnp.asarray(1.0, jnp.float32))
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        b1p, b2p = b1p * self._beta1, b2p * self._beta2
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        self._set_acc("beta1_pow", p, b1p)
+        self._set_acc("beta2_pow", p, b2p)
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + wd * master
+        w_norm = jnp.linalg.norm(master)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        self._write_back(p, master - self._lr * self._param_lr(p) * trust * r)
+
+
+class LBFGS(Optimizer):
+    """Simplified single-step LBFGS with history (reference:
+    optimizer/lbfgs.py)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False)
+        self._max_iter = max_iter
+        self._history = history_size
+        self._s, self._y = [], []
+        self._prev_flat = None
+        self._prev_grad = None
+
+    def _flat(self, vals):
+        return jnp.concatenate([v.reshape(-1) for v in vals])
+
+    def step(self, closure=None):
+        if closure is not None:
+            with no_grad_guard():
+                pass
+            loss = closure()
+        with no_grad_guard():
+            pg = self._collect_params_grads()
+            if not pg:
+                return
+            flat_g = self._flat([g._data.astype(jnp.float32) for _, g in pg])
+            flat_w = self._flat([p._data.astype(jnp.float32) for p, _ in pg])
+            if self._prev_flat is not None:
+                s = flat_w - self._prev_flat
+                y = flat_g - self._prev_grad
+                if float(jnp.dot(s, y)) > 1e-10:
+                    self._s.append(s)
+                    self._y.append(y)
+                    if len(self._s) > self._history:
+                        self._s.pop(0)
+                        self._y.pop(0)
+            q = flat_g
+            alphas = []
+            for s, y in zip(reversed(self._s), reversed(self._y)):
+                rho = 1.0 / jnp.dot(y, s)
+                a = rho * jnp.dot(s, q)
+                q = q - a * y
+                alphas.append((a, rho, s, y))
+            if self._s:
+                gamma = jnp.dot(self._s[-1], self._y[-1]) / jnp.dot(
+                    self._y[-1], self._y[-1])
+                q = gamma * q
+            for a, rho, s, y in reversed(alphas):
+                b = rho * jnp.dot(y, q)
+                q = q + (a - b) * s
+            d = -q
+            self._prev_flat = flat_w
+            self._prev_grad = flat_g
+            new_flat = flat_w + self._lr * d
+            ofs = 0
+            for p, _ in pg:
+                n = int(np.prod(p._data.shape)) if p._data.shape else 1
+                chunk = new_flat[ofs:ofs + n].reshape(p._data.shape)
+                p._data = chunk.astype(p._data.dtype)
+                ofs += n
+        return None
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff

@@ -1,0 +1,142 @@
+"""Profiler (reference: python/paddle/profiler/profiler.py:346 — host tracer +
+CUPTI merged into chrome traces).
+
+TPU-native: wraps jax.profiler (XPlane → TensorBoard/perfetto) and provides
+host-side RecordEvent spans via jax.profiler.TraceAnnotation."""
+
+from __future__ import annotations
+
+import os
+import time
+from enum import Enum
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        period = closed + ready + record
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handle(prof):
+        prof._export_dir = dir_name
+    return handle
+
+
+class Profiler:
+    """paddle.profiler.Profiler over jax.profiler."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._dir = "/tmp/paddle_tpu_profile"
+        self._running = False
+        self._step = 0
+        self._step_times = []
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        if not self._timer_only:
+            os.makedirs(self._dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self._dir)
+                self._running = True
+            except Exception:
+                self._running = False
+
+    def stop(self):
+        if self._running:
+            jax.profiler.stop_trace()
+            self._running = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self._step_times.append(now - self._t0)
+        self._t0 = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        avg = sum(self._step_times[-10:]) / len(self._step_times[-10:])
+        return f"avg step time {avg*1000:.2f} ms"
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        return self.step_info()
+
+    def export(self, path, format="json"):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class RecordEvent:
+    """Host-side trace span (reference: platform/profiler RecordEvent)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def load_profiler_result(path):
+    raise NotImplementedError

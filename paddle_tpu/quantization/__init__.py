@@ -1,0 +1,135 @@
+"""Quantization (reference: python/paddle/quantization/ — QAT qat.py:23,
+PTQ ptq.py:24, QuantConfig config.py:60).
+
+TPU-native: fake-quant ops in bf16/int8 with straight-through estimators;
+int8/fp8 matmuls lower onto the MXU natively."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def fake_quant(x, scale, bits=8):
+    qmax = 2 ** (bits - 1) - 1
+
+    def fn(v, s):
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
+        dq = q * s / qmax
+        # straight-through estimator
+        return v + jax.lax.stop_gradient(dq - v)
+    return apply_op("fake_quant", fn, x, scale)
+
+
+class BaseQuanter(Layer):
+    def scales(self):
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseQuanter):
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.bits = quant_bits
+        self.register_buffer("_scale", Tensor(jnp.ones((), jnp.float32)))
+
+    def forward(self, x):
+        m = jnp.max(jnp.abs(x._data.astype(jnp.float32)))
+        self._scale._data = jnp.maximum(self._scale._data, m)
+        return fake_quant(x, Tensor._wrap(self._scale._data), self.bits)
+
+    def scales(self):
+        return self._scale
+
+
+class FakeQuanterWithAbsMaxObserver(AbsmaxObserver):
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__(bit_length)
+        self.moving_rate = moving_rate
+
+    def forward(self, x):
+        m = jnp.max(jnp.abs(x._data.astype(jnp.float32)))
+        self._scale._data = (self.moving_rate * self._scale._data
+                             + (1 - self.moving_rate) * m)
+        return fake_quant(x, Tensor._wrap(self._scale._data), self.bits)
+
+
+QuanterFactory = FakeQuanterWithAbsMaxObserver
+
+
+class QuantConfig:
+    """reference: quantization/config.py:60."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs = {}
+        self._type_configs = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        for l in (layer if isinstance(layer, list) else [layer]):
+            self._layer_configs[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, list) else [layer_type]):
+            self._type_configs[t] = (activation, weight)
+
+    def _config_for(self, layer):
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self.activation, self.weight)
+
+
+class QuantedLinear(Layer):
+    def __init__(self, linear, act_quanter, w_quanter):
+        super().__init__()
+        self.inner = linear
+        self.act_quanter = act_quanter() if callable(act_quanter) else act_quanter
+        self.w_quanter = w_quanter() if callable(w_quanter) else w_quanter
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        w = self.inner.weight
+        if self.w_quanter is not None:
+            w = self.w_quanter(Tensor._wrap(w._data))
+        return F.linear(x, w, self.inner.bias)
+
+
+class QAT:
+    """Quantization-aware training (reference: quantization/qat.py:23)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        from ..nn import Linear
+        target = model
+        for name, sub in list(target.named_sublayers()):
+            if isinstance(sub, Linear):
+                act_q, w_q = self.config._config_for(sub)
+                if act_q is None and w_q is None:
+                    continue
+                parts = name.split(".")
+                parent = target
+                for p in parts[:-1]:
+                    parent = getattr(parent, p)
+                parent.add_sublayer(parts[-1],
+                                    QuantedLinear(sub, act_q, w_q))
+        return target
+
+    def convert(self, model, inplace=False):
+        return model
+
+
+class PTQ(QAT):
+    """Post-training quantization (reference: quantization/ptq.py:24)."""
+    pass

@@ -1,0 +1,94 @@
+"""paddle.static compat layer (reference: python/paddle/static/).
+
+TPU-native: there is no second graph IR — "static graph" IS jax.jit tracing
+(see paddle_tpu.jit).  This module keeps the Program/Executor API shape for
+user code portability: a Program records a python callable; Executor.run jits
+and runs it."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None,
+                 stop_gradient=False):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+
+class Program:
+    def __init__(self):
+        self._fn = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program):
+            out = program(**(feed or {}))
+            return out if isinstance(out, (list, tuple)) else [out]
+        if fetch_list:
+            return [f.numpy() if isinstance(f, Tensor) else f
+                    for f in fetch_list]
+        return []
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+class nn:
+    @staticmethod
+    def fc(x, size, **kwargs):
+        raise NotImplementedError("use paddle_tpu.nn.Linear")
+
+
+def save(program, path):
+    pass
+
+
+def load(program, path):
+    pass
+
+
+class amp:
+    pass

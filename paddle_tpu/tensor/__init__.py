@@ -1,0 +1,106 @@
+"""paddle_tpu.tensor — the op surface, and Tensor method attachment.
+
+Mirrors python/paddle/tensor/__init__.py which patches ~300 methods onto the
+Tensor type at import time (reference: tensor/__init__.py `tensor_method_func`
+list)."""
+
+from __future__ import annotations
+
+from ..core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+from . import (attribute, creation, einsum as einsum_mod, linalg, logic, manipulation,
+               math, random, search, stat)
+from .attribute import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+_METHOD_SOURCES = [math, manipulation, linalg, logic, search, stat, attribute]
+
+_SKIP = {"to_tensor", "Tensor", "Parameter", "builtins_sum", "builtins_slice"}
+
+
+def _attach_methods():
+    import types
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith("_") or name in _SKIP:
+                continue
+            fn = getattr(mod, name)
+            if not isinstance(fn, types.FunctionType):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    # a few renames / extras
+    Tensor.add_n = staticmethod(add_n) if "add_n" in globals() else None
+    Tensor.mod = math.remainder
+    Tensor.floor_mod = math.remainder
+    Tensor.reshape = manipulation.reshape
+    Tensor.reshape_ = manipulation.reshape_
+    Tensor.unbind = manipulation.unbind
+    Tensor.split = manipulation.split
+    Tensor.chunk = manipulation.chunk
+    Tensor.topk = search.topk
+    Tensor.einsum = lambda self, eq, *others: einsum(eq, self, *others)
+
+    def _add_(self, y, alpha=1):
+        return self._inplace_assign(self + (y * alpha if alpha != 1 else y))
+
+    def _subtract_(self, y):
+        return self._inplace_assign(self - y)
+
+    def _multiply_(self, y):
+        return self._inplace_assign(self * y)
+
+    def _divide_(self, y):
+        return self._inplace_assign(self / y)
+
+    def _scale_(self, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+                name=None):
+        return self._inplace_assign(math.scale(self, scale, bias,
+                                               bias_after_scale))
+
+    def _clip_(self, min=None, max=None, name=None):
+        return self._inplace_assign(math.clip(self, min, max))
+
+    def _exp_(self):
+        return self._inplace_assign(math.exp(self))
+
+    def _fill_(self, value):
+        return manipulation.fill_(self, value)
+
+    def _zero_(self):
+        return manipulation.zero__(self)
+
+    Tensor.add_ = _add_
+    Tensor.subtract_ = _subtract_
+    Tensor.multiply_ = _multiply_
+    Tensor.divide_ = _divide_
+    Tensor.scale_ = _scale_
+    Tensor.clip_ = _clip_
+    Tensor.exp_ = _exp_
+    Tensor.fill_ = _fill_
+    Tensor.zero_ = _zero_
+    Tensor.uniform_ = random.uniform_
+    Tensor.normal_ = random.normal_
+    Tensor.exponential_ = random.exponential_
+    Tensor.bernoulli_ = random.bernoulli_
+
+
+def add_n(inputs, name=None):
+    """paddle.add_n — sum a list of tensors."""
+    import functools
+    from ..core.dispatch import apply_op
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply_op("add_n",
+                    lambda *xs: functools.reduce(lambda a, b: a + b, xs),
+                    *inputs)
+
+
+_attach_methods()

@@ -1,0 +1,37 @@
+"""Tensor attribute helpers (reference: python/paddle/tensor/attribute.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def shape(input):
+    return Tensor._wrap(jnp.asarray(input._data.shape, dtype=jnp.int64))
+
+
+def rank(input):
+    return Tensor._wrap(jnp.asarray(input._data.ndim, dtype=jnp.int64))
+
+
+def is_complex(x):
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x.dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def real(x, name=None):
+    from .math import real as _r
+    return _r(x)
+
+
+def imag(x, name=None):
+    from .math import imag as _i
+    return _i(x)

@@ -1,0 +1,170 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
+
+
+def _dt(dtype, default=jnp.float32):
+    d = dtypes.convert_dtype(dtype)
+    return default if d is None else d
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor._wrap(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor._wrap(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = jnp.float32 if isinstance(fill_value, float) else None
+    return Tensor._wrap(jnp.full(_shape(shape), fill_value, d))
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor._wrap(jnp.zeros_like(x._data, dtype=dtypes.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor._wrap(jnp.ones_like(x._data, dtype=dtypes.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor._wrap(jnp.full_like(x._data, fill_value,
+                                      dtype=dtypes.convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = (np.dtype(np.float32)
+             if any(isinstance(v, float) for v in (start, end, step))
+             else np.dtype(np.int64))
+    return Tensor._wrap(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor._wrap(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                                     dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor._wrap(jnp.logspace(start, stop, int(num), base=base,
+                                     dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor._wrap(jnp.eye(int(num_rows),
+                                None if num_columns is None else int(num_columns),
+                                dtype=_dt(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = jnp.meshgrid(*[a._data for a in args], indexing="ij")
+    return [Tensor._wrap(o) for o in outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    if padding_value != 0 and x.ndim == 1:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, x.dtype)
+        return apply_op("diag", lambda v: base * (1 - jnp.eye(n, dtype=base.dtype))
+                        + jnp.diag(v, offset), x)
+    return apply_op("diag", lambda v: jnp.diag(v, offset), x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op("diagflat", lambda v: jnp.diagflat(v, offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op("tril", lambda v: jnp.tril(v, diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op("triu", lambda v: jnp.triu(v, diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor._wrap(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, jnp.int64)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor._wrap(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, jnp.int64)))
+
+
+def assign(x, output=None):
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    out = apply_op("assign", jnp.copy, x)
+    if output is not None:
+        output._inplace_assign(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return apply_op("assign", jnp.copy, x)
+
+
+def complex(real, imag, name=None):
+    return apply_op("complex", jax.lax.complex, real, imag)
+
+
+def polar(abs_t, angle, name=None):
+    return apply_op("polar",
+                    lambda a, th: a * jnp.exp(1j * th.astype(jnp.complex64)),
+                    abs_t, angle)
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    from .random import _next_key
+    u = jax.random.uniform(_next_key(), x._data.shape) - 0.5
+    x._data = (loc + scale * jnp.tan(np.pi * u)).astype(x.dtype)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    from .random import _next_key
+    u = jax.random.uniform(_next_key(), x._data.shape)
+    x._data = (jnp.floor(jnp.log1p(-u) / jnp.log1p(-probs)) + 1).astype(x.dtype)
+    return x

@@ -1,0 +1,17 @@
+"""einsum (reference: python/paddle/tensor/einsum.py — 1k LoC of manual
+planning; on TPU ``jnp.einsum`` lowers straight to dot_general on the MXU)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op, matmul_precision
+from ..core.tensor import Tensor
+
+
+def einsum(equation, *operands, name=None):
+    ops = [o if isinstance(o, Tensor) else Tensor(o) for o in operands]
+    return apply_op(
+        "einsum",
+        lambda *xs: jnp.einsum(equation, *xs, precision=matmul_precision()),
+        *ops)

@@ -1,0 +1,240 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from .math import matmul, mm, bmm, dot, inner, outer  # noqa: F401
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = _t(x)
+
+    def fn(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(v * v))
+            return jnp.linalg.norm(v, "fro" if isinstance(axis, (list, tuple))
+                                   else None, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+                                   keepdims=keepdim)
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=None if axis is None else axis,
+                           keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=None if axis is None else axis,
+                           keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype),
+                           axis=None if axis is None else axis, keepdims=keepdim)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.sum(jnp.abs(v) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply_op("p_norm", fn, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p, axis, keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply_op("matrix_norm",
+                    lambda v: jnp.linalg.norm(v, p, axis=tuple(axis),
+                                              keepdims=keepdim), _t(x))
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y, p)
+
+
+def t(input, name=None):
+    return apply_op("t", lambda v: v.T, _t(input))
+
+
+def transpose(x, perm, name=None):
+    from .manipulation import transpose as _tr
+    return _tr(x, perm)
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else (-1 if x.shape[-1] == 3 else
+                                 next(i for i, s in enumerate(x.shape) if s == 3))
+    return apply_op("cross", lambda a, b: jnp.cross(a, b, axis=ax), _t(x), _t(y))
+
+
+def cholesky(x, upper=False, name=None):
+    return apply_op("cholesky",
+                    lambda v: jnp.linalg.cholesky(v).swapaxes(-1, -2).conj()
+                    if upper else jnp.linalg.cholesky(v), _t(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return apply_op("cholesky_solve", fn, _t(x), _t(y))
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def fn(L):
+        n = L.shape[-1]
+        return jax.scipy.linalg.cho_solve((L, not upper), jnp.eye(n, dtype=L.dtype))
+    return apply_op("cholesky_inverse", fn, _t(x))
+
+
+def inv(x, name=None):
+    return apply_op("inverse", jnp.linalg.inv, _t(x))
+
+
+inverse = inv
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv", lambda v: jnp.linalg.pinv(v, rcond=rcond,
+                                                      hermitian=hermitian), _t(x))
+
+
+def det(x, name=None):
+    return apply_op("det", jnp.linalg.det, _t(x))
+
+
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(_t(x)._data)
+    out = apply_op("slogdet", lambda v: jnp.stack(jnp.linalg.slogdet(v)), _t(x))
+    return out
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), _t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor._wrap(jnp.linalg.matrix_rank(_t(x)._data, tol=tol))
+
+
+def qr(x, mode="reduced", name=None):
+    outs = apply_op("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)), _t(x),
+                    nout=2)
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op("svd",
+                    lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)),
+                    _t(x), nout=3)
+
+
+def svdvals(x, name=None):
+    return apply_op("svdvals",
+                    lambda v: jnp.linalg.svd(v, compute_uv=False), _t(x))
+
+
+def eig(x, name=None):
+    w, v = np.linalg.eig(np.asarray(_t(x)._data))
+    return Tensor._wrap(jnp.asarray(w)), Tensor._wrap(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op("eigh", lambda v: tuple(jnp.linalg.eigh(v,
+                                                            symmetrize_input=True)),
+                    _t(x), nout=2)
+
+
+def eigvals(x, name=None):
+    w = np.linalg.eigvals(np.asarray(_t(x)._data))
+    return Tensor._wrap(jnp.asarray(w))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op("eigvalsh", lambda v: jnp.linalg.eigvalsh(v), _t(x))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(_t(x)._data)
+    info = Tensor._wrap(jnp.zeros((), jnp.int32))
+    if get_infos:
+        return Tensor._wrap(lu_), Tensor._wrap(piv + 1), info
+    return Tensor._wrap(lu_), Tensor._wrap(piv + 1)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    lu_, piv = np.asarray(x._data), np.asarray(y._data) - 1
+    n = lu_.shape[-2]
+    P = np.eye(n)
+    perm = np.arange(n)
+    for i, p in enumerate(piv):
+        perm[[i, p]] = perm[[p, i]]
+    P = P[perm]
+    L = np.tril(lu_, -1) + np.eye(n)
+    U = np.triu(lu_)
+    return (Tensor._wrap(jnp.asarray(P.T)), Tensor._wrap(jnp.asarray(L)),
+            Tensor._wrap(jnp.asarray(U)))
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, _t(x), _t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply_op("triangular_solve", fn, _t(x), _t(y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(_t(x)._data, _t(y)._data, rcond=rcond)
+    return (Tensor._wrap(sol), Tensor._wrap(res), Tensor._wrap(rank),
+            Tensor._wrap(sv))
+
+
+def multi_dot(x, name=None):
+    xs = [_t(v) for v in x]
+    return apply_op("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs), *xs)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op("cov",
+                    lambda v: jnp.cov(v, rowvar=rowvar,
+                                      ddof=1 if ddof else 0), _t(x))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), _t(x))
+
+
+def cond(x, p=None, name=None):
+    return Tensor._wrap(jnp.linalg.cond(_t(x)._data, p))
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(t.shape[-1]):
+            v = jnp.zeros((m,), a.dtype).at[i].set(1.0).at[i + 1:].set(a[i + 1:, i])
+            q = q @ (jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v))
+        return q[:, :n]
+    return apply_op("householder_product", fn, _t(x), _t(tau))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    d = _t(x)._data
+    if q is None:
+        q = min(6, d.shape[-2], d.shape[-1])
+    if center:
+        d = d - d.mean(axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(d, full_matrices=False)
+    return (Tensor._wrap(u[..., :q]), Tensor._wrap(s[..., :q]),
+            Tensor._wrap(jnp.swapaxes(vt, -1, -2)[..., :q]))
+
+
+def matrix_exp(x, name=None):
+    return apply_op("matrix_exp", jax.scipy.linalg.expm, _t(x))

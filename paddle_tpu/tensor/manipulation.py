@@ -1,0 +1,591 @@
+"""Shape/layout manipulation ops
+(reference: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _static_shape(shape):
+    out = []
+    for s in (shape if isinstance(shape, (list, tuple)) else [shape]):
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    shp = _static_shape(shape)
+    return apply_op("reshape", lambda v: jnp.reshape(v, shp), _t(x))
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace_assign(reshape(x, shape))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+view_as = lambda x, other, name=None: reshape(x, other.shape)  # noqa: E731
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _t(x)
+    nd = x.ndim
+    s = start_axis if start_axis >= 0 else start_axis + nd
+    e = stop_axis if stop_axis >= 0 else stop_axis + nd
+    shp = x.shape[:s] + [int(np.prod(x.shape[s:e + 1] or [1]))] + x.shape[e + 1:]
+    return reshape(x, shp)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._inplace_assign(flatten(x, start_axis, stop_axis))
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return apply_op("transpose", lambda v: jnp.transpose(v, perm), _t(x))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis", lambda v: jnp.moveaxis(v, source, destination),
+                    _t(x))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op("swapaxes", lambda v: jnp.swapaxes(v, axis0, axis1), _t(x))
+
+
+transpose_ = lambda x, perm, name=None: x._inplace_assign(transpose(x, perm))  # noqa: E731
+
+
+def unsqueeze(x, axis, name=None):
+    ax = axis
+    if isinstance(ax, Tensor):
+        ax = [int(v) for v in np.atleast_1d(ax.numpy())]
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(int(a) for a in ax)
+    return apply_op("unsqueeze", lambda v: jnp.expand_dims(v, ax), _t(x))
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace_assign(unsqueeze(x, axis))
+
+
+def squeeze(x, axis=None, name=None):
+    x = _t(x)
+
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a if a >= 0 else a + v.ndim for a in axes)
+        axes = tuple(a for a in axes if v.shape[a] == 1)
+        return jnp.squeeze(v, axes) if axes else v
+    return apply_op("squeeze", fn, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace_assign(squeeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    xs = [_t(v) for v in x]
+    return apply_op("concat", lambda *vs: jnp.concatenate(vs, axis=ax), *xs)
+
+
+def stack(x, axis=0, name=None):
+    xs = [_t(v) for v in x]
+    return apply_op("stack", lambda *vs: jnp.stack(vs, axis=axis), *xs)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = _t(x)
+    n = x.shape[axis] if num is None else num
+    outs = apply_op("unstack",
+                    lambda v: tuple(jnp.squeeze(s, axis) for s in
+                                    jnp.split(v, n, axis)), x, nout=n)
+    return list(outs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        outs = apply_op("split", lambda v: tuple(jnp.split(v, n, ax)), x, nout=n)
+        return list(outs)
+    sections = [int(s) for s in num_or_sections]
+    total = x.shape[ax]
+    if any(s == -1 for s in sections):
+        known = builtins_sum(s for s in sections if s != -1)
+        sections = [total - known if s == -1 else s for s in sections]
+    idx = np.cumsum(sections)[:-1].tolist()
+    outs = apply_op("split", lambda v: tuple(jnp.split(v, idx, ax)), x,
+                    nout=len(sections))
+    return list(outs)
+
+
+def builtins_sum(it):
+    import builtins
+    return builtins.sum(it)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = _t(x)
+    outs = jnp.array_split(x._data, num_or_indices, axis) \
+        if isinstance(num_or_indices, int) else \
+        jnp.split(x._data, [int(i) for i in num_or_indices], axis)
+    n = len(outs)
+    if isinstance(num_or_indices, int):
+        return list(apply_op("tensor_split",
+                             lambda v: tuple(jnp.array_split(v, num_or_indices, axis)),
+                             x, nout=n))
+    idx = [int(i) for i in num_or_indices]
+    return list(apply_op("tensor_split",
+                         lambda v: tuple(jnp.split(v, idx, axis)), x, nout=n))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return apply_op("tile", lambda v: jnp.tile(v, reps), _t(x))
+
+
+def expand(x, shape, name=None):
+    shp = _static_shape(shape)
+    x = _t(x)
+
+    def fn(v):
+        tgt = list(shp)
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - len(tgt) + v.ndim]
+        return jnp.broadcast_to(v, tgt)
+    return apply_op("expand", fn, x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    datas = [i._data for i in inputs]
+    shp = np.broadcast_shapes(*[d.shape for d in datas])
+    return [apply_op("broadcast_to", lambda v: jnp.broadcast_to(v, shp), i)
+            for i in inputs]
+
+
+def flip(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op("flip", lambda v: jnp.flip(v, tuple(ax)), _t(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op("roll", lambda v: jnp.roll(v, shifts, axis), _t(x))
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op("gather",
+                    lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i,
+                                          axis=ax), _t(x), index)
+
+
+def gather_nd(x, index, name=None):
+    def fn(v, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return v[idx]
+    return apply_op("gather_nd", fn, _t(x), index)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op("take_along_axis",
+                    lambda v, i: jnp.take_along_axis(v, i, axis=axis),
+                    _t(arr), indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    values = values if isinstance(values, Tensor) else Tensor(values)
+
+    def fn(v, i, val):
+        val = jnp.broadcast_to(val.astype(v.dtype), i.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, val, axis=axis, inplace=False)
+        dn = jnp.zeros_like(v)
+        cnt = jnp.zeros_like(v)
+        dims = list(range(v.ndim))
+        # scatter-add via .at
+        idx = [jnp.broadcast_to(
+            jnp.arange(i.shape[d]).reshape([-1 if k == d else 1
+                                            for k in range(i.ndim)]), i.shape)
+            for d in dims]
+        idx[axis] = i
+        if reduce in ("add", "sum"):
+            return v.at[tuple(idx)].add(val)
+        if reduce in ("mul", "multiply"):
+            return v.at[tuple(idx)].multiply(val)
+        if reduce == "amax":
+            return v.at[tuple(idx)].max(val)
+        if reduce == "amin":
+            return v.at[tuple(idx)].min(val)
+        if reduce == "mean":
+            summed = v.at[tuple(idx)].add(val)
+            counts = jnp.ones_like(v).at[tuple(idx)].add(jnp.ones_like(val))
+            return summed / counts
+        raise ValueError(f"unknown reduce {reduce}")
+    return apply_op("put_along_axis", fn, _t(arr), indices, values)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u.astype(v.dtype))
+        return v.at[i].set(jnp.zeros_like(u, dtype=v.dtype)).at[i].add(
+            u.astype(v.dtype))
+    return apply_op("scatter", fn, _t(x), index, _t(updates))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace_assign(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return v.at[idx].add(u.astype(v.dtype))
+    return apply_op("scatter_nd_add", fn, _t(x), index, _t(updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zero = Tensor._wrap(jnp.zeros(_static_shape(shape), updates.dtype))
+    return scatter_nd_add(zero, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select",
+                    lambda v, i: jnp.take(v, i, axis=axis), _t(x), index)
+
+
+def index_sample(x, index):
+    def fn(v, i):
+        return jnp.take_along_axis(v, i, axis=1)
+    return apply_op("index_sample", fn, _t(x), index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(v, i, val):
+        sl = [slice(None)] * v.ndim
+        idx = [jnp.broadcast_to(
+            jnp.arange(val.shape[d]).reshape([-1 if k == d else 1
+                                              for k in range(val.ndim)]),
+            val.shape) for d in range(val.ndim)]
+        idx[axis] = jnp.broadcast_to(
+            i.reshape([-1 if k == axis else 1 for k in range(val.ndim)]),
+            val.shape)
+        return v.at[tuple(idx)].add(val.astype(v.dtype))
+    return apply_op("index_add", fn, _t(x), index, _t(value))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(i._data if isinstance(i, Tensor) else i for i in indices)
+
+    def fn(v, val):
+        if accumulate:
+            return v.at[idx].add(val.astype(v.dtype))
+        return v.at[idx].set(val.astype(v.dtype))
+    return apply_op("index_put", fn, _t(x), _t(value))
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(v, i):
+        sl = [slice(None)] * v.ndim
+        sl[axis] = i
+        return v.at[tuple(sl)].set(value)
+    return apply_op("index_fill", fn, _t(x), index)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    x = _t(x)
+    if axis is None:
+        x = flatten(x)
+        ax = 0
+    else:
+        ax = axis
+    if isinstance(r, int):
+        return apply_op("repeat_interleave",
+                        lambda v: jnp.repeat(v, r, axis=ax), x)
+    total = int(np.asarray(r).sum())
+    return apply_op("repeat_interleave",
+                    lambda v, rr: jnp.repeat(v, rr, axis=ax,
+                                             total_repeat_length=total), x,
+                    Tensor._wrap(jnp.asarray(r)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    d = _t(x)._data
+    res = jnp.unique(d, return_index=return_index, return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor._wrap(res)
+    return tuple(Tensor._wrap(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    d = np.asarray(_t(x)._data)
+    if axis is None:
+        d = d.reshape(-1)
+        keep = np.concatenate([[True], d[1:] != d[:-1]])
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    out = d[keep]
+    rets = [Tensor._wrap(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        rets.append(Tensor._wrap(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        cnt = np.diff(np.append(idx, d.size))
+        rets.append(Tensor._wrap(jnp.asarray(cnt)))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = _t(x)
+    if isinstance(pad, Tensor):
+        pad = [int(p) for p in pad.numpy()]
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle semantics: pad applies to last len(pad)//2 spatial dims,
+        # ordered from last dim backwards (like torch.nn.functional.pad)
+        k = len(pad) // 2
+        width = [(0, 0)] * (nd - k) + [
+            (pad[2 * i], pad[2 * i + 1]) for i in range(k)][::-1]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return apply_op("pad", lambda v: jnp.pad(v, width, jmode,
+                                                 constant_values=value), x)
+    return apply_op("pad", lambda v: jnp.pad(v, width, jmode), x)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def fn(v):
+        flat = v.reshape(-1)
+        idx = np.zeros(tuple(shape), dtype=np.int64) + offset
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            idx += np.arange(s).reshape([-1 if k == d else 1
+                                         for k in range(len(shape))]) * st
+        return flat[idx]
+    return apply_op("as_strided", fn, _t(x))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(v):
+        sl = [slice(None)] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = slice(s, e, st)
+        return v[tuple(sl)]
+    return apply_op("strided_slice", fn, _t(x))
+
+
+def slice(x, axes, starts, ends, name=None):
+    def _v(s):
+        return int(s.item()) if isinstance(s, Tensor) else int(s)
+
+    def fn(v):
+        sl = [builtins_slice(None)] * v.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            sl[ax] = builtins_slice(_v(s), _v(e))
+        return v[tuple(sl)]
+    return apply_op("slice_op", fn, _t(x))
+
+
+def builtins_slice(*a):
+    import builtins
+    return builtins.slice(*a)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _t(x)
+    shape = _static_shape(shape)
+    offsets = [0] * x.ndim if offsets is None else [
+        int(o.item()) if isinstance(o, Tensor) else int(o) for o in offsets]
+
+    def fn(v):
+        sl = tuple(builtins_slice(o, o + (s if s != -1 else v.shape[d] - o))
+                   for d, (o, s) in enumerate(zip(offsets, shape)))
+        return v[sl]
+    return apply_op("crop", fn, x)
+
+
+def masked_select(x, mask, name=None):
+    d = _t(x)._data
+    m = mask._data if isinstance(mask, Tensor) else mask
+    return Tensor._wrap(d[m])
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value.item() if isinstance(value, Tensor) else value
+    return apply_op("masked_fill",
+                    lambda d, m: jnp.where(m, jnp.asarray(v, d.dtype), d),
+                    _t(x), mask)
+
+
+def masked_fill_(x, mask, value, name=None):
+    return x._inplace_assign(masked_fill(x, mask, value))
+
+
+def masked_scatter(x, mask, value, name=None):
+    d = np.asarray(_t(x)._data).copy()
+    m = np.asarray(mask._data, dtype=bool)
+    vals = np.asarray(value._data).reshape(-1)
+    d[m] = vals[: int(m.sum())]
+    return Tensor._wrap(jnp.asarray(d))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op("where",
+                    lambda c, a, b: jnp.where(c, a, b),
+                    condition, _t(x), _t(y))
+
+
+def nonzero(x, as_tuple=False):
+    d = np.asarray(_t(x)._data)
+    nz = np.nonzero(d)
+    if as_tuple:
+        return tuple(Tensor._wrap(jnp.asarray(n)) for n in nz)
+    return Tensor._wrap(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def rotate90(x, k=1, axes=(0, 1)):
+    return apply_op("rot90", lambda v: jnp.rot90(v, k, axes), _t(x))
+
+
+def fill_(x, value):
+    x._data = jnp.full_like(x._data, value)
+    return x
+
+
+def zero__(x):
+    x._data = jnp.zeros_like(x._data)
+    return x
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    n = min(x.shape[-2], x.shape[-1])
+    idx = jnp.arange(n - (offset if offset >= 0 else -offset))
+    x._data = x._data.at[..., idx + (0 if offset >= 0 else -offset),
+                         idx + (offset if offset >= 0 else 0)].set(value)
+    return x
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [Tensor._wrap(jnp.atleast_1d(_t(i)._data)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [Tensor._wrap(jnp.atleast_2d(_t(i)._data)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [Tensor._wrap(jnp.atleast_3d(_t(i)._data)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hstack(x, name=None):
+    xs = [_t(v) for v in x]
+    return apply_op("hstack", lambda *vs: jnp.hstack(vs), *xs)
+
+
+def vstack(x, name=None):
+    xs = [_t(v) for v in x]
+    return apply_op("vstack", lambda *vs: jnp.vstack(vs), *xs)
+
+
+def dstack(x, name=None):
+    xs = [_t(v) for v in x]
+    return apply_op("dstack", lambda *vs: jnp.dstack(vs), *xs)
+
+
+def column_stack(x, name=None):
+    xs = [_t(v) for v in x]
+    return apply_op("column_stack", lambda *vs: jnp.column_stack(vs), *xs)
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def unflatten(x, axis, shape, name=None):
+    x = _t(x)
+    ax = axis if axis >= 0 else axis + x.ndim
+    shp = list(_static_shape(shape))
+    if -1 in shp:
+        known = int(np.prod([s for s in shp if s != -1]))
+        shp[shp.index(-1)] = x.shape[ax] // known
+    new_shape = x.shape[:ax] + shp + x.shape[ax + 1:]
+    return reshape(x, new_shape)
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+def numel(x, name=None):
+    return Tensor._wrap(jnp.asarray(int(np.prod(x._data.shape)), jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def fn(v):
+        in_shard = (v // shard_size) == shard_id
+        return jnp.where(in_shard, v % shard_size, ignore_value)
+    return apply_op("shard_index", fn, _t(input))

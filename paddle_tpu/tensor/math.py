@@ -1,0 +1,438 @@
+"""Math ops (reference: python/paddle/tensor/math.py — each wrapper there
+branches eager/static and calls ``_C_ops.*``; here each op is one traceable
+jnp/lax function dispatched through apply_op)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return apply_op(name_, fn, _t(x))
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+def _binary(name, fn):
+    def op(x, y, name=None):
+        y = y if isinstance(y, (int, float)) else _t(y)
+        return apply_op(name_, fn, _t(x), y)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+# -- elementwise unary -------------------------------------------------------
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+sign = _unary("sign", jnp.sign)
+ceil = _unary("ceil", jnp.ceil)
+floor = _unary("floor", jnp.floor)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.lax.erf)
+erfinv = _unary("erfinv", jax.lax.erf_inv)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+neg = _unary("neg", jnp.negative)
+negative = neg
+conj = _unary("conj", jnp.conj)
+angle = _unary("angle", jnp.angle)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+gamma = _unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+i0 = _unary("i0", jax.scipy.special.i0)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+logit = _unary("logit", jax.scipy.special.logit)
+nan_to_num = _unary("nan_to_num", jnp.nan_to_num)
+
+
+def deg2rad(x, name=None):
+    return apply_op("deg2rad", jnp.deg2rad, _t(x))
+
+
+def rad2deg(x, name=None):
+    return apply_op("rad2deg", jnp.rad2deg, _t(x))
+
+
+def exponent(x):
+    return apply_op("exponent", lambda v: jnp.floor(jnp.log2(jnp.abs(v))), _t(x))
+
+
+# -- elementwise binary ------------------------------------------------------
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.true_divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+remainder = _binary("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow = _binary("pow", jnp.power)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+nextafter = _binary("nextafter", jnp.nextafter)
+copysign = _binary("copysign", jnp.copysign)
+heaviside = _binary("heaviside", jnp.heaviside)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+ldexp = _binary("ldexp", jnp.ldexp)
+
+
+def divide_no_nan(x, y):
+    return apply_op("divide_no_nan",
+                    lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)),
+                    _t(x), _t(y))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+    if bias_after_scale:
+        out = apply_op("scale", lambda v: v * s + bias, _t(x))
+    else:
+        out = apply_op("scale", lambda v: (v + bias) * s, _t(x))
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    stacked = [i._data for i in inputs]
+    return apply_op(
+        "multiplex",
+        lambda idx, *xs: jnp.stack(xs, 0)[idx.reshape(-1),
+                                          jnp.arange(xs[0].shape[0])],
+        index, *inputs)
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return apply_op("clip", lambda v: jnp.clip(v, mn, mx), _t(x))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply_op("lerp", lambda a, b, w: a + w * (b - a), _t(x), _t(y),
+                        weight)
+    return apply_op("lerp", lambda a, b: a + weight * (b - a), _t(x), _t(y))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), _t(x))
+
+
+# -- reductions --------------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy()
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax, dt = _axis(axis), dtypes.convert_dtype(dtype)
+    x = _t(x)
+    if dt is None and dtypes.is_integer(x.dtype) or x.dtype == jnp.bool_:
+        dt = np.dtype(np.int64)
+    return apply_op("sum", lambda v: jnp.sum(v, axis=ax, dtype=dt,
+                                             keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op("mean", lambda v: jnp.mean(v, axis=ax, keepdims=keepdim),
+                    _t(x))
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op("max", lambda v: jnp.max(v, axis=ax, keepdims=keepdim), _t(x))
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op("min", lambda v: jnp.min(v, axis=ax, keepdims=keepdim), _t(x))
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = _axis(axis)
+    dt = dtypes.convert_dtype(dtype)
+    return apply_op("prod", lambda v: jnp.prod(v, axis=ax, dtype=dt,
+                                               keepdims=keepdim), _t(x))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op("logsumexp",
+                    lambda v: jax.scipy.special.logsumexp(v, axis=ax,
+                                                          keepdims=keepdim),
+                    _t(x))
+
+
+def log_normalize(x, axis=-1):
+    return apply_op("log_normalize",
+                    lambda v: v - jax.scipy.special.logsumexp(
+                        v, axis=axis, keepdims=True), _t(x))
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op("nansum", lambda v: jnp.nansum(v, axis=ax, keepdims=keepdim),
+                    _t(x))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op("nanmean", lambda v: jnp.nanmean(v, axis=ax, keepdims=keepdim),
+                    _t(x))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return Tensor._wrap(jnp.count_nonzero(_t(x)._data, axis=ax, keepdims=keepdim))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return Tensor._wrap(jnp.all(_t(x)._data, axis=ax, keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return Tensor._wrap(jnp.any(_t(x)._data, axis=ax, keepdims=keepdim))
+
+
+# -- cumulative --------------------------------------------------------------
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = _t(x)
+    if axis is None:
+        return apply_op("cumsum", lambda v: jnp.cumsum(v.reshape(-1)), x)
+    return apply_op("cumsum", lambda v: jnp.cumsum(v, axis=int(axis)), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply_op("cumprod", lambda v: jnp.cumprod(v, axis=int(dim)), _t(x))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = _t(x)
+    ax = -1 if axis is None else int(axis)
+    v = jax.lax.cummax(x._data, axis=ax if ax >= 0 else x.ndim + ax)
+    idx = jnp.argmax(jnp.cumsum((x._data == v).astype(jnp.int32), axis=ax), axis=ax)
+    out = apply_op("cummax", lambda t: jax.lax.cummax(t, axis=ax if ax >= 0 else t.ndim + ax), x)
+    return out, Tensor._wrap(idx)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = _t(x)
+    ax = -1 if axis is None else int(axis)
+    out = apply_op("cummin", lambda t: jax.lax.cummin(t, axis=ax if ax >= 0 else t.ndim + ax), x)
+    idx = jnp.argmax((x._data == out._data).astype(jnp.int32), axis=ax)
+    return out, Tensor._wrap(idx)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = _t(x)
+    ax = 0 if axis is None else int(axis)
+    if axis is None:
+        return apply_op("logcumsumexp",
+                        lambda v: jax.lax.cumlogsumexp(v.reshape(-1)), x)
+    return apply_op("logcumsumexp", lambda v: jax.lax.cumlogsumexp(v, axis=ax), x)
+
+
+# -- matmul family -----------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    from ..core.dispatch import matmul_precision
+
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=matmul_precision())
+    return apply_op("matmul", fn, _t(x), _t(y))
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return apply_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), _t(x), _t(y))
+
+
+def inner(x, y, name=None):
+    return apply_op("inner", jnp.inner, _t(x), _t(y))
+
+
+def outer(x, y, name=None):
+    return apply_op("outer", jnp.outer, _t(x), _t(y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op("addmm",
+                    lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                    _t(input), _t(x), _t(y))
+
+
+def kron(x, y, name=None):
+    return apply_op("kron", jnp.kron, _t(x), _t(y))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("trace", lambda v: jnp.trace(v, offset, axis1, axis2), _t(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("diagonal",
+                    lambda v: jnp.diagonal(v, offset, axis1, axis2), _t(x))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def fn(v):
+        n = v.shape[-1] + (offset if offset >= 0 else -offset)
+        pad = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + (0 if offset >= 0 else -offset)
+        c = idx + (offset if offset >= 0 else 0)
+        pad = pad.at[..., r, c].set(v)
+        if (dim1, dim2) != (-2, -1):
+            pad = jnp.moveaxis(pad, -2, dim1 if dim1 >= 0 else pad.ndim + dim1)
+            pad = jnp.moveaxis(pad, -1, dim2 if dim2 >= 0 else pad.ndim + dim2)
+        return pad
+    return apply_op("diag_embed", fn, _t(x))
+
+
+# -- misc --------------------------------------------------------------------
+def increment(x, value=1.0, name=None):
+    out = apply_op("increment", lambda v: v + value, x)
+    return x._inplace_assign(out)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor._wrap(jnp.isclose(_t(x)._data, _t(y)._data, rtol, atol,
+                                    equal_nan))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor._wrap(jnp.allclose(_t(x)._data, _t(y)._data, rtol, atol,
+                                     equal_nan))
+
+
+def equal_all(x, y, name=None):
+    return Tensor._wrap(jnp.array_equal(_t(x)._data, _t(y)._data))
+
+
+def inverse(x, name=None):
+    return apply_op("inverse", jnp.linalg.inv, _t(x))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda v: jnp.rot90(v, k, axes), _t(x))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    d = input._data
+    lo, hi = (min, max) if (min != 0 or max != 0) else (d.min(), d.max())
+    h, _ = jnp.histogram(d, bins=bins, range=(lo, hi))
+    return Tensor._wrap(h.astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = weights._data if weights is not None else None
+    return Tensor._wrap(jnp.bincount(x._data, w, minlength=minlength))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def take(x, index, mode="raise", name=None):
+    return apply_op("take", lambda v, i: jnp.take(v.reshape(-1), i,
+                                                  mode="clip" if mode == "clip" else "wrap"),
+                    _t(x), index)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply_op("trapezoid",
+                        lambda yy, xx: jax.scipy.integrate.trapezoid(yy, xx, axis=axis),
+                        _t(y), _t(x))
+    return apply_op("trapezoid",
+                    lambda yy: jax.scipy.integrate.trapezoid(
+                        yy, dx=1.0 if dx is None else dx, axis=axis), _t(y))
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def fn(yy, xx=None):
+        d = (jnp.diff(xx, axis=axis) if xx is not None
+             else (1.0 if dx is None else dx))
+        s1 = [slice(None)] * yy.ndim
+        s2 = [slice(None)] * yy.ndim
+        s1[axis] = slice(1, None)
+        s2[axis] = slice(None, -1)
+        avg = (yy[tuple(s1)] + yy[tuple(s2)]) / 2.0
+        return jnp.cumsum(avg * d, axis=axis)
+    if x is not None:
+        return apply_op("cumulative_trapezoid", fn, _t(y), _t(x))
+    return apply_op("cumulative_trapezoid", fn, _t(y))

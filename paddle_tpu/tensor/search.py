@@ -1,0 +1,137 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core import dtype as dtypes
+    d = _t(x)._data
+    r = jnp.argmax(d if axis is not None else d.reshape(-1),
+                   axis=axis, keepdims=keepdim if axis is not None else False)
+    return Tensor._wrap(r.astype(dtypes.convert_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core import dtype as dtypes
+    d = _t(x)._data
+    r = jnp.argmin(d if axis is not None else d.reshape(-1),
+                   axis=axis, keepdims=keepdim if axis is not None else False)
+    return Tensor._wrap(r.astype(dtypes.convert_dtype(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    d = _t(x)._data
+    r = jnp.argsort(-d if descending else d, axis=axis, stable=stable)
+    return Tensor._wrap(r.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        s = jnp.sort(v, axis=axis, stable=stable)
+        return jnp.flip(s, axis=axis) if descending else s
+    return apply_op("sort", fn, _t(x))
+
+
+def _topk_impl(vv, k, largest):
+    import jax
+    if largest:
+        return jax.lax.top_k(vv, k)
+    nv, ni = jax.lax.top_k(-vv, k)
+    return -nv, ni
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = _t(x)
+    k = int(k.item()) if isinstance(k, Tensor) else int(k)
+    ax = -1 if axis is None else axis
+    last = ax in (-1, x.ndim - 1)
+
+    def fn(v):
+        vv = v if last else jnp.moveaxis(v, ax, -1)
+        vals, _ = _topk_impl(vv, k, largest)
+        return vals if last else jnp.moveaxis(vals, -1, ax)
+
+    d = x._data
+    vv = d if last else jnp.moveaxis(d, ax, -1)
+    _, idx = _topk_impl(vv, k, largest)
+    if not last:
+        idx = jnp.moveaxis(idx, -1, ax)
+    vals = apply_op("topk", fn, x)
+    return vals, Tensor._wrap(idx.astype(jnp.int64))
+
+
+def where(condition, x=None, y=None, name=None):
+    from .manipulation import where as _w
+    return _w(condition, x, y, name)
+
+
+def nonzero(x, as_tuple=False):
+    from .manipulation import nonzero as _nz
+    return _nz(x, as_tuple)
+
+
+def index_select(x, index, axis=0, name=None):
+    from .manipulation import index_select as _is
+    return _is(x, index, axis)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    r = jnp.searchsorted(sorted_sequence._data, _t(values)._data,
+                         side="right" if right else "left")
+    return Tensor._wrap(r.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = _t(x)
+
+    def fn(v):
+        s = jnp.sort(v, axis=axis)
+        out = jnp.take(s, k - 1, axis=axis)
+        return jnp.expand_dims(out, axis) if keepdim else out
+    vals = apply_op("kthvalue", fn, x)
+    idx = jnp.take(jnp.argsort(x._data, axis=axis), k - 1, axis=axis)
+    if keepdim:
+        idx = jnp.expand_dims(idx, axis)
+    return vals, Tensor._wrap(idx.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    d = np.asarray(_t(x)._data)
+    d2 = np.moveaxis(d, axis, -1)
+    flat = d2.reshape(-1, d2.shape[-1])
+    vals, idxs = [], []
+    for row in flat:
+        u, c = np.unique(row, return_counts=True)
+        v = u[np.argmax(c)]
+        vals.append(v)
+        idxs.append(np.where(row == v)[0][-1])
+    shp = d2.shape[:-1]
+    v = np.asarray(vals).reshape(shp)
+    i = np.asarray(idxs).reshape(shp)
+    if keepdim:
+        v = np.expand_dims(v, axis)
+        i = np.expand_dims(i, axis)
+    return Tensor._wrap(jnp.asarray(v)), Tensor._wrap(jnp.asarray(i, np.int64))
+
+
+import jax  # noqa: E402  (used inside topk impl)

@@ -1,0 +1,71 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from .math import _axis, _t, mean, sum  # noqa: F401
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op("var",
+                    lambda v: jnp.var(v, axis=ax, ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), _t(x))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op("std",
+                    lambda v: jnp.std(v, axis=ax, ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), _t(x))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+
+    def fn(v):
+        if mode == "avg":
+            return jnp.median(v, axis=ax, keepdims=keepdim)
+        # 'min' mode: lower of the two middle elements
+        vv = jnp.sort(v if ax is not None else v.reshape(-1), axis=ax if ax is not None else 0)
+        n = vv.shape[ax if ax is not None else 0]
+        return jnp.take(vv, (n - 1) // 2, axis=ax if ax is not None else 0)
+    out = apply_op("median", fn, _t(x))
+    return out
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+    return apply_op("nanmedian",
+                    lambda v: jnp.nanmedian(v, axis=ax, keepdims=keepdim), _t(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axis(axis)
+    qs = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply_op("quantile",
+                    lambda v: jnp.quantile(v, qs, axis=ax, keepdims=keepdim,
+                                           method=interpolation), _t(x))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    ax = _axis(axis)
+    qs = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply_op("nanquantile",
+                    lambda v: jnp.nanquantile(v, qs, axis=ax, keepdims=keepdim,
+                                              method=interpolation), _t(x))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    d = np.asarray(x._data)
+    w = np.asarray(weights._data) if weights is not None else None
+    h, edges = np.histogramdd(d, bins=bins, range=ranges, density=density,
+                              weights=w)
+    return (Tensor._wrap(jnp.asarray(h)),
+            [Tensor._wrap(jnp.asarray(e)) for e in edges])

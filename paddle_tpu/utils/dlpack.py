@@ -1,0 +1,17 @@
+"""DLPack interop (reference: paddle/fluid/framework/dlpack_tensor.cc,
+python/paddle/utils/dlpack.py)."""
+
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+def to_dlpack(x):
+    return x._data.__dlpack__()
+
+
+def from_dlpack(capsule):
+    import jax.numpy as jnp
+    return Tensor._wrap(jnp.from_dlpack(capsule))

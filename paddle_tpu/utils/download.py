@@ -1,0 +1,15 @@
+"""Download helper (reference: python/paddle/utils/download.py). Zero-egress
+environment: only local cache hits succeed."""
+
+import os
+
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    fname = os.path.join(WEIGHTS_HOME, url.split("/")[-1])
+    if os.path.exists(fname):
+        return fname
+    raise RuntimeError(
+        f"network access disabled; place the file at {fname} manually")

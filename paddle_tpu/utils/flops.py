@@ -1,0 +1,34 @@
+"""Model FLOPs counter (reference: python/paddle/utils/flops.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from ..core.tensor import Tensor
+    from ..nn import Conv2D, Linear
+    total = [0]
+    hooks = []
+
+    def count_linear(layer, inp, out):
+        total[0] += 2 * int(np.prod(inp[0].shape)) * layer.weight.shape[1]
+
+    def count_conv(layer, inp, out):
+        oshape = out.shape if not isinstance(out, (tuple, list)) else out[0].shape
+        kh, kw = layer._kernel_size
+        cin = layer._in_channels // layer._groups
+        total[0] += 2 * int(np.prod(oshape)) * cin * kh * kw
+
+    for lay in net.sublayers(include_self=True):
+        if isinstance(lay, Linear):
+            hooks.append(lay.register_forward_post_hook(count_linear))
+        elif isinstance(lay, Conv2D):
+            hooks.append(lay.register_forward_post_hook(count_conv))
+    import jax.numpy as jnp
+    x = Tensor(jnp.zeros(input_size, jnp.float32))
+    net.eval()
+    net(x)
+    for h in hooks:
+        h.remove()
+    return total[0]

@@ -1,0 +1,19 @@
+"""Unique name generator (reference: python/paddle/utils/unique_name.py)."""
+
+import itertools
+
+_counters = {}
+
+
+def generate(key):
+    c = _counters.setdefault(key, itertools.count())
+    return f"{key}_{next(c)}"
+
+
+def guard(new_generator=None):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def switch(new_generator=None):
+    pass

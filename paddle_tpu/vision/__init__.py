@@ -1,0 +1,20 @@
+"""Vision domain (reference: python/paddle/vision/)."""
+
+from . import datasets, models, ops, transforms  # noqa: F401
+from .models import (LeNet, ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
+                     resnet152)
+
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(backend)
+
+
+def get_image_backend():
+    return "tensor"
+
+
+def image_load(path, backend=None):
+    import numpy as np
+    from PIL import Image
+    return Image.open(path)

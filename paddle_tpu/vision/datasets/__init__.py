@@ -1,0 +1,147 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).  Zero-egress:
+datasets load from local files; MNIST/Cifar parse the standard archives if
+present under ~/.cache/paddle_tpu/datasets."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        prefix = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(
+            DATA_HOME, "mnist", f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            DATA_HOME, "mnist", f"{prefix}-labels-idx1-ubyte.gz")
+        if not os.path.exists(image_path):
+            raise RuntimeError(
+                f"MNIST files not found at {image_path}; network download is "
+                "disabled — place the ubyte.gz files there")
+        with gzip.open(image_path, "rb") as f:
+            data = np.frombuffer(f.read(), np.uint8, offset=16)
+            self.images = data.reshape(-1, 28, 28).astype(np.float32)
+        with gzip.open(label_path, "rb") as f:
+            self.labels = np.frombuffer(f.read(), np.uint8, offset=8).astype(
+                np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx][..., None]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        data_file = data_file or os.path.join(DATA_HOME,
+                                              "cifar-10-python.tar.gz")
+        if not os.path.exists(data_file):
+            raise RuntimeError(f"Cifar10 archive not found at {data_file}")
+        self.images, self.labels = [], []
+        with tarfile.open(data_file) as tf:
+            names = ([f"cifar-10-batches-py/data_batch_{i}" for i in
+                      range(1, 6)] if mode == "train"
+                     else ["cifar-10-batches-py/test_batch"])
+            for name in names:
+                d = pickle.load(tf.extractfile(name), encoding="bytes")
+                self.images.append(d[b"data"].reshape(-1, 3, 32, 32))
+                self.labels.extend(d[b"labels"])
+        self.images = np.concatenate(self.images).astype(np.float32)
+        self.labels = np.asarray(self.labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        data_file = data_file or os.path.join(DATA_HOME,
+                                              "cifar-100-python.tar.gz")
+        if not os.path.exists(data_file):
+            raise RuntimeError(f"Cifar100 archive not found at {data_file}")
+        with tarfile.open(data_file) as tf:
+            name = ("cifar-100-python/train" if mode == "train"
+                    else "cifar-100-python/test")
+            d = pickle.load(tf.extractfile(name), encoding="bytes")
+            self.images = d[b"data"].reshape(-1, 3, 32, 32).astype(np.float32)
+            self.labels = np.asarray(d[b"fine_labels"], np.int64)
+
+
+class DatasetFolder(Dataset):
+    """ImageFolder-style tree: root/class_x/img.jpg."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(extensions):
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        from PIL import Image
+        return np.asarray(Image.open(path).convert("RGB"))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+ImageFolder = DatasetFolder
+
+
+class FlowersDataset(Dataset):
+    def __init__(self, *a, **k):
+        raise RuntimeError("Flowers download disabled (zero egress)")
+
+
+Flowers = FlowersDataset
+VOC2012 = FlowersDataset

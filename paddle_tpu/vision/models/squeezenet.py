@@ -1,0 +1,65 @@
+"""SqueezeNet (reference: python/paddle/vision/models/squeezenet.py)."""
+
+from ...nn import (AdaptiveAvgPool2D, Conv2D, Dropout, MaxPool2D, ReLU,
+                   Sequential)
+from ...nn.layer.layers import Layer
+
+
+class Fire(Layer):
+    def __init__(self, inp, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Conv2D(inp, squeeze, 1)
+        self.relu = ReLU()
+        self.expand1 = Conv2D(squeeze, e1, 1)
+        self.expand3 = Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+        x = self.relu(self.squeeze(x))
+        return concat([self.relu(self.expand1(x)),
+                       self.relu(self.expand3(x))], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(),
+                MaxPool2D(3, 2, 0),
+                Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128), MaxPool2D(3, 2),
+                Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2), Fire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(),
+                MaxPool2D(3, 2),
+                Fire(64, 16, 64, 64), Fire(128, 16, 64, 64),
+                MaxPool2D(3, 2),
+                Fire(128, 32, 128, 128), Fire(256, 32, 128, 128),
+                MaxPool2D(3, 2),
+                Fire(256, 48, 192, 192), Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256), Fire(512, 64, 256, 256))
+        self.classifier = Sequential(
+            Dropout(0.5), Conv2D(512, num_classes, 1), ReLU(),
+            AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        from ...tensor.manipulation import flatten
+        return flatten(x, 1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (zero egress)")
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (zero egress)")
+    return SqueezeNet("1.1", **kwargs)

@@ -1,0 +1,319 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — yolo_box:58,
+roi_align:1640, nms:1867, deform_conv2d:753; CUDA kernels
+phi/kernels/gpu/{deformable_conv,roi_align,nms}_kernel.cu).
+
+TPU-native: gather/einsum formulations — XLA lowers bilinear sampling to
+vectorized gathers; nms runs as a lax.fori_loop suppression (static shapes)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op, matmul_precision
+from ..core.tensor import Tensor
+
+
+def _bilinear_sample(feat, y, x):
+    """feat [C, H, W]; y/x arbitrary-shaped float coords; returns [C, *coords]."""
+    c, h, w = feat.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = y - y0
+    wx1 = x - x0
+    wy0 = 1 - wy1
+    wx0 = 1 - wx1
+
+    def get(yy, xx):
+        valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yy = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xx = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        v = feat[:, yy, xx]
+        return jnp.where(valid, v, 0.0)
+
+    return (get(y0, x0) * (wy0 * wx0) + get(y0, x1) * (wy0 * wx1)
+            + get(y1, x0) * (wy1 * wx0) + get(y1, x1) * (wy1 * wx1))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference kernel: phi/kernels/gpu/roi_align_kernel.cu"""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    boxes_per_img = np.asarray(boxes_num._data if isinstance(boxes_num, Tensor)
+                               else boxes_num)
+    img_idx = np.repeat(np.arange(len(boxes_per_img)), boxes_per_img)
+    img_idx_j = jnp.asarray(img_idx)
+
+    def fn(feat, bx):
+        offset = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - offset
+        y1 = bx[:, 1] * spatial_scale - offset
+        x2 = bx[:, 2] * spatial_scale - offset
+        y2 = bx[:, 3] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        iy = (jnp.arange(ph)[:, None, None]
+              + (jnp.arange(sr)[None, :, None] + 0.5) / sr)  # [ph, sr, 1]
+        ix = (jnp.arange(pw)[None, None, :]
+              + 0.0)
+        # sample grid per roi: y = y1 + (py + (s+0.5)/sr) * bin_h
+        ys = (y1[:, None, None] + (jnp.arange(ph)[None, :, None] * bin_h[:, None, None])
+              + (jnp.arange(sr)[None, None, :] + 0.5) / sr * bin_h[:, None, None])
+        xs = (x1[:, None, None] + (jnp.arange(pw)[None, :, None] * bin_w[:, None, None])
+              + (jnp.arange(sr)[None, None, :] + 0.5) / sr * bin_w[:, None, None])
+
+        def per_roi(i):
+            f = feat[img_idx_j[i]]
+            yy = ys[i]  # [ph, sr]
+            xx = xs[i]  # [pw, sr]
+            ygrid = yy[:, None, :, None]  # [ph,1,sr,1]
+            xgrid = xx[None, :, None, :]  # [1,pw,1,sr]
+            ygrid = jnp.broadcast_to(ygrid, (ph, pw, sr, sr))
+            xgrid = jnp.broadcast_to(xgrid, (ph, pw, sr, sr))
+            samples = _bilinear_sample(f, ygrid, xgrid)  # [C, ph, pw, sr, sr]
+            return samples.mean(axis=(-1, -2))
+
+        return jax.vmap(per_roi)(jnp.arange(bx.shape[0]))
+    return apply_op("roi_align", fn, x, boxes)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    return roi_align(x, boxes, boxes_num, output_size, spatial_scale, 1, False)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """reference kernel: phi/kernels/gpu/nms_kernel.cu.  Host-side numpy (the
+    output is ragged/dynamic — inference-time op)."""
+    b = np.asarray(boxes._data)
+    if scores is None:
+        order = np.arange(len(b))
+    else:
+        order = np.argsort(-np.asarray(scores._data))
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for _i in order:
+        if suppressed[_i]:
+            continue
+        keep.append(_i)
+        xx1 = np.maximum(b[_i, 0], b[order, 0])
+        yy1 = np.maximum(b[_i, 1], b[order, 1])
+        xx2 = np.minimum(b[_i, 2], b[order, 2])
+        yy2 = np.minimum(b[_i, 3], b[order, 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        iou = inter / (area[_i] + area[order] - inter + 1e-10)
+        suppressed[order[iou > iou_threshold]] = True
+        suppressed[_i] = False
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor._wrap(jnp.asarray(keep))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference kernel:
+    phi/kernels/gpu/deformable_conv_kernel.cu).  Gather-based sampling +
+    one MXU matmul over the unfolded patches."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+
+    def fn(v, off, w, *rest):
+        n, cin, h, wd = v.shape
+        cout, cin_g, kh, kw = w.shape
+        oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (wd + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        i = 0
+        m = None
+        bval = None
+        if mask is not None:
+            m = rest[i]
+            i += 1
+        if bias is not None:
+            bval = rest[i]
+        # base sampling grid
+        base_y = (jnp.arange(oh) * sh - ph)[:, None, None] \
+            + (jnp.arange(kh) * dh)[None, :, None]  # [oh, kh, 1]
+        base_x = (jnp.arange(ow) * sw - pw)[:, None, None] \
+            + (jnp.arange(kw) * dw)[None, :, None]  # [ow, kw, 1]
+        off = off.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+
+        def per_image(vi, offi, mi):
+            cols = []
+            cpg = cin // deformable_groups
+            for g in range(deformable_groups):
+                feat = vi[g * cpg:(g + 1) * cpg]
+                oy = offi[g, :, 0]  # [kh*kw, oh, ow]
+                ox = offi[g, :, 1]
+                yy = (base_y[:, :, 0].reshape(oh, kh)[None].transpose(2, 1, 0))
+                # build [kh*kw, oh, ow] absolute coords
+                gy = (jnp.arange(oh) * sh - ph)[None, :, None] + \
+                    (jnp.repeat(jnp.arange(kh) * dh, kw))[:, None, None] + oy
+                gx = (jnp.arange(ow) * sw - pw)[None, None, :] + \
+                    (jnp.tile(jnp.arange(kw) * dw, kh))[:, None, None] + ox
+                sampled = _bilinear_sample(feat, gy, gx)  # [cpg, kh*kw, oh, ow]
+                if mi is not None:
+                    sampled = sampled * mi[g][None]
+                cols.append(sampled)
+            col = jnp.concatenate(cols, axis=0)  # [cin, kh*kw, oh, ow]
+            col = col.reshape(cin * kh * kw, oh * ow)
+            wmat = w.reshape(cout, cin_g * kh * kw)
+            if groups > 1:
+                outs = []
+                cpg2 = (cin * kh * kw) // groups
+                opg = cout // groups
+                for g in range(groups):
+                    outs.append(wmat[g * opg:(g + 1) * opg] @
+                                col[g * cpg2:(g + 1) * cpg2])
+                out = jnp.concatenate(outs, 0)
+            else:
+                out = jnp.matmul(wmat, col, precision=matmul_precision())
+            return out.reshape(cout, oh, ow)
+
+        if m is not None:
+            m = m.reshape(n, deformable_groups, kh * kw, oh, ow)
+            out = jax.vmap(per_image)(v, off, m)
+        else:
+            out = jax.vmap(lambda a, b: per_image(a, b, None))(v, off)
+        if bval is not None:
+            out = out + bval.reshape(1, -1, 1, 1)
+        return out
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply_op("deform_conv2d", fn, *args)
+
+
+class DeformConv2D:
+    """Layer wrapper (reference: vision/ops.py DeformConv2D)."""
+
+    def __new__(cls, in_channels, out_channels, kernel_size, stride=1,
+                padding=0, dilation=1, deformable_groups=1, groups=1,
+                weight_attr=None, bias_attr=None):
+        from ..nn.layer.layers import Layer
+        from ..nn.functional.init_utils import param_attr_init
+        from ..nn.initializer import KaimingUniform, Constant
+
+        class _DeformConv2D(Layer):
+            def __init__(self):
+                super().__init__()
+                ks = (kernel_size, kernel_size) if isinstance(
+                    kernel_size, int) else tuple(kernel_size)
+                self.weight = param_attr_init(
+                    (out_channels, in_channels // groups) + ks, self._dtype,
+                    weight_attr, False, KaimingUniform())
+                self.bias = (param_attr_init((out_channels,), self._dtype,
+                                             bias_attr, True, Constant(0.0))
+                             if bias_attr is not False else None)
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(x, offset, self.weight, self.bias,
+                                     stride, padding, dilation,
+                                     deformable_groups, groups, mask)
+        return _DeformConv2D()
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """reference: vision/ops.py yolo_box:58 (kernel
+    phi/kernels/gpu/yolo_box_kernel.cu)."""
+    na = len(anchors) // 2
+
+    def fn(v, imgs):
+        n, c, h, w = v.shape
+        v = v.reshape(n, na, -1, h, w)
+        box = v[:, :, :4]
+        conf = jax.nn.sigmoid(v[:, :, 4:5])
+        cls_prob = jax.nn.sigmoid(v[:, :, 5:5 + class_num])
+        gx = (jax.nn.sigmoid(box[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + jnp.arange(w)[None, None, None, :]) / w
+        gy = (jax.nn.sigmoid(box[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + jnp.arange(h)[None, None, :, None]) / h
+        anc = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+        gw = jnp.exp(box[:, :, 2]) * anc[None, :, 0, None, None] / (
+            w * downsample_ratio)
+        gh = jnp.exp(box[:, :, 3]) * anc[None, :, 1, None, None] / (
+            h * downsample_ratio)
+        imw = imgs[:, 1][:, None, None, None]
+        imh = imgs[:, 0][:, None, None, None]
+        x1 = (gx - gw / 2) * imw
+        y1 = (gy - gh / 2) * imh
+        x2 = (gx + gw / 2) * imw
+        y2 = (gy + gh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+        scores = (conf * cls_prob).transpose(0, 1, 3, 4, 2).reshape(
+            n, -1, class_num)
+        mask = (conf.reshape(n, -1, 1) >= conf_thresh)
+        boxes = jnp.where(mask, boxes, 0.0)
+        scores = jnp.where(mask, scores, 0.0)
+        return boxes, scores
+    return apply_op("yolo_box", fn, x, img_size, nout=2)
+
+
+def yolo_loss(*args, **kwargs):
+    raise NotImplementedError("yolo_loss lands with the detection recipes")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    rois = np.asarray(fpn_rois._data)
+    scale = np.sqrt((rois[:, 2] - rois[:, 0]) * (rois[:, 3] - rois[:, 1]))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.where(lvl == l)[0]
+        outs.append(Tensor._wrap(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+    restore = np.argsort(np.concatenate(idxs)) if idxs else np.zeros(0)
+    return outs, Tensor._wrap(jnp.asarray(restore.astype(np.int32)))
+
+
+def generate_proposals(*args, **kwargs):
+    raise NotImplementedError
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    def fn(pb, pbv, tb):
+        pw = pb[:, 2] - pb[:, 0] + (0 if box_normalized else 1)
+        ph = pb[:, 3] - pb[:, 1] + (0 if box_normalized else 1)
+        px = pb[:, 0] + pw * 0.5
+        py = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + (0 if box_normalized else 1)
+            th = tb[:, 3] - tb[:, 1] + (0 if box_normalized else 1)
+            tx = tb[:, 0] + tw * 0.5
+            ty = tb[:, 1] + th * 0.5
+            ox = (tx[:, None] - px[None]) / pw[None] / pbv[None, :, 0]
+            oy = (ty[:, None] - py[None]) / ph[None] / pbv[None, :, 1]
+            ow = jnp.log(tw[:, None] / pw[None]) / pbv[None, :, 2]
+            oh = jnp.log(th[:, None] / ph[None]) / pbv[None, :, 3]
+            return jnp.stack([ox, oy, ow, oh], -1)
+        raise NotImplementedError(code_type)
+    return apply_op("box_coder", fn, prior_box, prior_box_var, target_box)
+
+
+def psroi_pool(*args, **kwargs):
+    raise NotImplementedError

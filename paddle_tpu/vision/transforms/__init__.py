@@ -1,0 +1,276 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/) — numpy
+host-side pipeline (runs in DataLoader workers)."""
+
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+def _to_np(img):
+    if isinstance(img, np.ndarray):
+        return img
+    if isinstance(img, Tensor):
+        return img.numpy()
+    return np.asarray(img)  # PIL
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _to_np(img).astype(np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return Tensor(arr)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = img.numpy() if isinstance(img, Tensor) else _to_np(img).astype(
+            np.float32)
+        shape = ((-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1))
+        out = (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        import jax
+        import jax.numpy as jnp
+        arr = _to_np(img)
+        hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        h, w = (arr.shape[0], arr.shape[1]) if (hwc or arr.ndim == 2) else \
+            (arr.shape[1], arr.shape[2])
+        th, tw = self.size
+        method = "nearest" if self.interpolation == "nearest" else "bilinear"
+        if arr.ndim == 2:
+            out = jax.image.resize(jnp.asarray(arr, jnp.float32), (th, tw),
+                                   method)
+        elif hwc:
+            out = jax.image.resize(jnp.asarray(arr, jnp.float32),
+                                   (th, tw, arr.shape[-1]), method)
+        else:
+            out = jax.image.resize(jnp.asarray(arr, jnp.float32),
+                                   (arr.shape[0], th, tw), method)
+        return np.asarray(out).astype(arr.dtype)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        if self.padding:
+            p = self.padding
+            if isinstance(p, int):
+                p = (p, p, p, p)
+            pads = ((p[1], p[3]), (p[0], p[2]))
+            if arr.ndim == 3:
+                pads = pads + ((0, 0),) if hwc else ((0, 0),) + pads
+            arr = np.pad(arr, pads)
+        h, w = (arr.shape[0], arr.shape[1]) if (hwc or arr.ndim == 2) else \
+            (arr.shape[1], arr.shape[2])
+        th, tw = self.size
+        i = random.randint(0, h - th)
+        j = random.randint(0, w - tw)
+        if hwc or arr.ndim == 2:
+            return arr[i:i + th, j:j + tw]
+        return arr[:, i:i + th, j:j + tw]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        h, w = (arr.shape[0], arr.shape[1]) if (hwc or arr.ndim == 2) else \
+            (arr.shape[1], arr.shape[2])
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        if hwc or arr.ndim == 2:
+            return arr[i:i + th, j:j + tw]
+        return arr[:, i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        if random.random() < self.prob:
+            hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+            axis = 1 if (hwc or arr.ndim == 2) else 2
+            return np.flip(arr, axis).copy()
+        return arr
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        if random.random() < self.prob:
+            hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+            axis = 0 if (hwc or arr.ndim == 2) else 1
+            return np.flip(arr, axis).copy()
+        return arr
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        h, w = (arr.shape[0], arr.shape[1]) if (hwc or arr.ndim == 2) else \
+            (arr.shape[1], arr.shape[2])
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = random.randint(0, h - th)
+                j = random.randint(0, w - tw)
+                crop = (arr[i:i + th, j:j + tw] if (hwc or arr.ndim == 2)
+                        else arr[:, i:i + th, j:j + tw])
+                return self._resize._apply_image(crop)
+        return self._resize._apply_image(arr)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_np(img).astype(np.float32)
+        f = 1 + random.uniform(-self.value, self.value)
+        return np.clip(arr * f, 0, 255).astype(np.uint8) \
+            if arr.max() > 1.5 else np.clip(arr * f, 0, 1)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.brightness = brightness
+
+    def _apply_image(self, img):
+        if self.brightness:
+            return BrightnessTransform(self.brightness)._apply_image(img)
+        return _to_np(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    arr = _to_np(img)
+    hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+    axis = 1 if (hwc or arr.ndim == 2) else 2
+    return np.flip(arr, axis).copy()
+
+
+def vflip(img):
+    arr = _to_np(img)
+    hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+    axis = 0 if (hwc or arr.ndim == 2) else 1
+    return np.flip(arr, axis).copy()
+
+
+def crop(img, top, left, height, width):
+    arr = _to_np(img)
+    hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+    if hwc or arr.ndim == 2:
+        return arr[top:top + height, left:left + width]
+    return arr[:, top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)

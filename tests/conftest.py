@@ -1,0 +1,21 @@
+"""Test config: 8 virtual CPU devices (SURVEY §4 — the XPU op-test harness
+pattern: same suite runs on a simulated multi-device backend)."""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+# the axon sitecustomize pins jax_platforms=axon; override for tests
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    yield

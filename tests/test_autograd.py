@@ -1,0 +1,153 @@
+"""Autograd engine tests (reference pattern: op_test.py check_grad —
+analytic vs numeric)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def np_t(x):
+    return np.asarray(x.numpy())
+
+
+class TestBackward:
+    def test_simple(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        assert np.allclose(np_t(x.grad), [4, 6])
+
+    def test_chain(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.exp(x * 2)
+        z = paddle.log(y)  # z = 2x -> dz/dx = 2
+        z.backward()
+        assert np.allclose(np_t(x.grad), [2.0], atol=1e-5)
+
+    def test_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        assert np.allclose(np_t(x.grad), [5.0])
+
+    def test_branching(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        a = x * 3
+        b = a + a * a  # d/da = 1 + 2a = 13; da/dx = 3 -> 39
+        b.backward()
+        assert np.allclose(np_t(x.grad), [39.0])
+
+    def test_matmul_grad(self):
+        a = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.random.randn(4, 2).astype(np.float32),
+                             stop_gradient=False)
+        paddle.matmul(a, b).sum().backward()
+        assert np.allclose(np_t(a.grad), np_t(b).sum(1)[None, :].repeat(3, 0),
+                           atol=1e-5)
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        assert np.allclose(np_t(x.grad), [4.0])
+
+    def test_freed_graph_raises(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * x
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0])  # stop_gradient True
+        z = x * y
+        z.backward()
+        assert np.allclose(np_t(x.grad), [2.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = (x * x).detach()
+        z = y * x
+        z.backward()
+        assert np.allclose(np_t(x.grad), [9.0])
+
+    def test_non_scalar_backward_with_grad(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 3
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        assert np.allclose(np_t(x.grad), [3.0, 30.0])
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(float(g.numpy())))
+        (x * 5).backward()
+        assert seen == [5.0]
+
+    def test_numeric_grad_check(self):
+        # analytic vs numeric for a composite fn (OpTest check_grad pattern)
+        def f(a):
+            return float((paddle.tanh(a) * paddle.exp(-a)).sum().numpy())
+
+        x_np = np.array([0.3, -0.7, 1.2], np.float32)
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        (paddle.tanh(x) * paddle.exp(-x)).sum().backward()
+        eps = 1e-3
+        for i in range(3):
+            xp = x_np.copy()
+            xp[i] += eps
+            xm = x_np.copy()
+            xm[i] -= eps
+            num = (f(paddle.to_tensor(xp)) - f(paddle.to_tensor(xm))) / (2 * eps)
+            assert abs(float(np_t(x.grad)[i]) - num) < 1e-2
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x ** 3
+        (g,) = paddle.grad(y, x)
+        assert np.allclose(np_t(g), [12.0])
+        assert x.grad is None  # grad() must not touch .grad
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y._node is None
+
+    def test_pylayer(self):
+        class Double(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = Double.apply(x)
+        assert np.allclose(np_t(y), [6.0])
+        y.backward()
+        assert np.allclose(np_t(x.grad), [2.0])
+
+    def test_functional_vjp_jvp(self):
+        def f(x):
+            return x * x
+
+        out, g = paddle.autograd.vjp(f, paddle.to_tensor([3.0]))
+        assert np.allclose(np_t(out), [9.0])
+        out, t = paddle.autograd.jvp(f, paddle.to_tensor([3.0]))
+        assert np.allclose(np_t(t), [6.0])
+
+    def test_jacobian_hessian(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        jac = paddle.autograd.jacobian(lambda v: v * v, x)
+        assert np.allclose(jac.numpy(), np.diag([2.0, 4.0]))

@@ -1,0 +1,303 @@
+"""nn layers + functional tests (reference: test/legacy_test per-layer
+tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def np_t(x):
+    return np.asarray(x.numpy())
+
+
+class TestLinear:
+    def test_forward_backward(self):
+        lin = nn.Linear(4, 3)
+        x = paddle.randn([2, 4])
+        y = lin(x)
+        assert y.shape == [2, 3]
+        assert np.allclose(np_t(y), np_t(x) @ np_t(lin.weight)
+                           + np_t(lin.bias), atol=1e-5)
+        y.sum().backward()
+        assert lin.weight.grad is not None
+        assert lin.bias.grad.shape == [3]
+
+    def test_no_bias(self):
+        lin = nn.Linear(4, 3, bias_attr=False)
+        assert lin.bias is None
+
+
+class TestActivations:
+    def test_values(self):
+        x = paddle.to_tensor([-1.0, 0.0, 2.0])
+        assert np.allclose(np_t(F.relu(x)), [0, 0, 2])
+        assert np.allclose(np_t(F.sigmoid(x)),
+                           1 / (1 + np.exp([1, 0, -2])), rtol=1e-5)
+        assert np.allclose(np_t(F.softmax(x)).sum(), 1.0, rtol=1e-6)
+        assert np.allclose(np_t(F.gelu(paddle.to_tensor([0.0]))), [0.0])
+        assert np.allclose(np_t(F.silu(x)), np_t(x) / (1 + np.exp(-np_t(x))),
+                           rtol=1e-5)
+
+    def test_layers(self):
+        x = paddle.randn([3, 4])
+        for L in [nn.ReLU(), nn.GELU(), nn.Tanh(), nn.Sigmoid(),
+                  nn.LeakyReLU(0.1), nn.Softmax(-1), nn.Silu()]:
+            assert L(x).shape == [3, 4]
+
+
+class TestConv:
+    def test_conv2d_shape(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = paddle.randn([2, 3, 16, 16])
+        y = conv(x)
+        assert y.shape == [2, 8, 8, 8]
+        y.sum().backward()
+        assert conv.weight.grad.shape == [8, 3, 3, 3]
+
+    def test_conv2d_numpy_parity(self):
+        # 1x1 conv == matmul
+        conv = nn.Conv2D(2, 3, 1, bias_attr=False)
+        x = paddle.randn([1, 2, 4, 4])
+        y = conv(x)
+        w = np_t(conv.weight).reshape(3, 2)
+        expected = np.einsum("oc,bchw->bohw", w, np_t(x))
+        assert np.allclose(np_t(y), expected, atol=1e-5)
+
+    def test_groups_depthwise(self):
+        conv = nn.Conv2D(4, 4, 3, padding=1, groups=4)
+        assert conv(paddle.randn([1, 4, 8, 8])).shape == [1, 4, 8, 8]
+
+    def test_conv_transpose(self):
+        convt = nn.Conv2DTranspose(3, 2, 2, stride=2)
+        y = convt(paddle.randn([1, 3, 4, 4]))
+        assert y.shape == [1, 2, 8, 8]
+
+    def test_conv1d(self):
+        c = nn.Conv1D(2, 4, 3, padding=1)
+        assert c(paddle.randn([2, 2, 10])).shape == [2, 4, 10]
+
+
+class TestNorm:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.randn([4, 3, 8, 8])
+        bn.train()
+        y = bn(x)
+        out = np_t(y)
+        assert abs(out.mean()) < 1e-4
+        assert abs(out.std() - 1.0) < 1e-2
+        # running stats updated
+        assert not np.allclose(np_t(bn._mean), 0.0)
+        bn.eval()
+        y2 = bn(x)
+        assert y2.shape == [4, 3, 8, 8]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = paddle.randn([2, 4, 8])
+        y = np_t(ln(x))
+        assert np.allclose(y.mean(-1), 0, atol=1e-5)
+        assert np.allclose(y.std(-1), 1, atol=1e-1)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = paddle.randn([2, 8])
+        y = np_t(rn(x))
+        expected = np_t(x) / np.sqrt((np_t(x) ** 2).mean(-1, keepdims=True)
+                                     + 1e-6)
+        assert np.allclose(y, expected, atol=1e-5)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        assert gn(paddle.randn([2, 4, 5, 5])).shape == [2, 4, 5, 5]
+
+
+class TestPooling:
+    def test_maxpool_avgpool(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(
+            1, 1, 4, 4))
+        y = F.max_pool2d(x, 2)
+        assert np.allclose(np_t(y).reshape(-1), [5, 7, 13, 15])
+        y = F.avg_pool2d(x, 2)
+        assert np.allclose(np_t(y).reshape(-1), [2.5, 4.5, 10.5, 12.5])
+
+    def test_adaptive(self):
+        x = paddle.randn([2, 3, 8, 8])
+        assert F.adaptive_avg_pool2d(x, 1).shape == [2, 3, 1, 1]
+        assert F.adaptive_avg_pool2d(x, (2, 4)).shape == [2, 3, 2, 4]
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = paddle.to_tensor([[10.0, 0.0, 0.0], [0.0, 10.0, 0.0]])
+        labels = paddle.to_tensor([0, 1])
+        loss = F.cross_entropy(logits, labels)
+        assert float(loss.numpy()) < 0.01
+        # soft label
+        soft = paddle.to_tensor([[1.0, 0, 0], [0, 1.0, 0]])
+        loss2 = F.cross_entropy(logits, soft, soft_label=True)
+        assert float(loss2.numpy()) < 0.01
+
+    def test_ignore_index(self):
+        logits = paddle.randn([4, 5])
+        labels = paddle.to_tensor([0, -100, 2, -100])
+        loss = F.cross_entropy(logits, labels)
+        manual = F.cross_entropy(logits[paddle.to_tensor([0, 2])],
+                                 paddle.to_tensor([0, 2]))
+        assert abs(float(loss.numpy()) - float(manual.numpy())) < 1e-5
+
+    def test_mse_l1_bce(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([1.5, 1.0])
+        assert abs(float(F.mse_loss(a, b).numpy()) - 0.625) < 1e-6
+        assert abs(float(F.l1_loss(a, b).numpy()) - 0.75) < 1e-6
+        p = paddle.to_tensor([0.9, 0.1])
+        y = paddle.to_tensor([1.0, 0.0])
+        assert float(F.binary_cross_entropy(p, y).numpy()) < 0.2
+
+    def test_kl_smooth(self):
+        lp = F.log_softmax(paddle.randn([2, 5]), -1)
+        t = F.softmax(paddle.randn([2, 5]), -1)
+        assert np.isfinite(float(F.kl_div(lp, t).numpy()))
+        assert np.isfinite(float(F.smooth_l1_loss(
+            paddle.randn([3]), paddle.randn([3])).numpy()))
+
+
+class TestEmbeddingDropout:
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor([[1, 2], [3, 4]])
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        assert np.allclose(np_t(out)[0, 0], np_t(emb.weight)[1])
+        out.sum().backward()
+        assert emb.weight.grad is not None
+
+    def test_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor([0, 1]))
+        assert np.allclose(np_t(out)[0], 0.0)
+
+    def test_dropout(self):
+        x = paddle.ones([100, 100])
+        d = nn.Dropout(0.5)
+        d.train()
+        y = np_t(d(x))
+        frac = (y == 0).mean()
+        assert 0.3 < frac < 0.7
+        # upscale: kept values are doubled
+        assert np.allclose(y[y != 0], 2.0)
+        d.eval()
+        assert np.allclose(np_t(d(x)), 1.0)
+
+
+class TestAttention:
+    def test_sdpa_matches_naive(self):
+        q = paddle.randn([2, 8, 2, 4])
+        k = paddle.randn([2, 8, 2, 4])
+        v = paddle.randn([2, 8, 2, 4])
+        out = F.scaled_dot_product_attention(q, k, v)
+        qn, kn, vn = np_t(q), np_t(k), np_t(v)
+        logits = np.einsum("bshd,bthd->bhst", qn, kn) / np.sqrt(4)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        expected = np.einsum("bhst,bthd->bshd", p, vn)
+        assert np.allclose(np_t(out), expected, atol=1e-4)
+
+    def test_causal(self):
+        q = paddle.randn([1, 6, 1, 8])
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        # first position attends only to itself -> equals v[0]
+        assert np.allclose(np_t(out)[0, 0, 0], np_t(q)[0, 0, 0], atol=1e-5)
+
+    def test_multihead_layer(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 5, 16])
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+        out.sum().backward()
+        assert mha.q_proj.weight.grad is not None
+
+
+class TestTransformer:
+    def test_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.randn([2, 6, 16])
+        out = enc(x)
+        assert out.shape == [2, 6, 16]
+        out.mean().backward()
+
+    def test_full_transformer(self):
+        tr = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                            num_decoder_layers=1, dim_feedforward=32,
+                            dropout=0.0)
+        src = paddle.randn([2, 5, 16])
+        tgt = paddle.randn([2, 3, 16])
+        out = tr(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+
+class TestRNN:
+    def test_lstm(self):
+        lstm = nn.LSTM(4, 8, num_layers=1)
+        x = paddle.randn([2, 5, 4])
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [1, 2, 8]
+        out.sum().backward()
+
+    def test_gru_bidirect(self):
+        gru = nn.GRU(4, 8, direction="bidirect")
+        out, h = gru(paddle.randn([2, 5, 4]))
+        assert out.shape == [2, 5, 16]
+
+
+class TestLayerBase:
+    def test_state_dict_roundtrip(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = net.state_dict()
+        assert len(sd) == 4
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net2.set_state_dict(sd)
+        x = paddle.randn([1, 4])
+        assert np.allclose(np_t(net(x)), np_t(net2(x)))
+
+    def test_named_parameters(self):
+        net = nn.Sequential(nn.Linear(2, 2))
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["0.weight", "0.bias"]
+
+    def test_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda l, i, o: calls.append(1))
+        lin(paddle.randn([1, 2]))
+        assert calls == [1]
+        h.remove()
+        lin(paddle.randn([1, 2]))
+        assert calls == [1]
+
+    def test_train_eval_propagates(self):
+        net = nn.Sequential(nn.Dropout(0.5))
+        net.eval()
+        assert not net[0].training
+        net.train()
+        assert net[0].training
+
+    def test_layerlist_parameterlist(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        assert len(list(ll.parameters())) == 6
+
+    def test_clip_grad(self):
+        lin = nn.Linear(4, 4)
+        (lin(paddle.randn([8, 4])) * 100).sum().backward()
+        nn.clip_grad_norm_(lin.parameters(), 1.0)
+        total = sum(float((p.grad * p.grad).sum().numpy())
+                    for p in lin.parameters())
+        assert total <= 1.01

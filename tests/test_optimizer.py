@@ -1,0 +1,184 @@
+"""Optimizer + LR scheduler + AMP tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def np_t(x):
+    return np.asarray(x.numpy())
+
+
+def quad_problem():
+    # minimize ||w - 3||^2
+    w = paddle.nn.ParameterList(
+        [paddle.Parameter(np.zeros(4, np.float32))])
+    return w
+
+
+def run_opt(opt_cls, steps=60, **kw):
+    w = paddle.Parameter(np.zeros(4, np.float32))
+    opt = opt_cls(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = ((w - 3.0) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return np_t(w)
+
+
+class TestOptimizers:
+    def test_sgd(self):
+        assert np.allclose(run_opt(paddle.optimizer.SGD, learning_rate=0.1),
+                           3.0, atol=1e-2)
+
+    def test_momentum(self):
+        assert np.allclose(run_opt(paddle.optimizer.Momentum, steps=300,
+                                   learning_rate=0.02), 3.0, atol=1e-1)
+
+    def test_adam(self):
+        assert np.allclose(run_opt(paddle.optimizer.Adam, steps=300,
+                                   learning_rate=0.1), 3.0, atol=1e-1)
+
+    def test_adamw(self):
+        out = run_opt(paddle.optimizer.AdamW, steps=300, learning_rate=0.1,
+                      weight_decay=0.0)
+        assert np.allclose(out, 3.0, atol=1e-1)
+
+    def test_adamw_decay(self):
+        # strong decay pulls weights below the target
+        out = run_opt(paddle.optimizer.AdamW, steps=300, learning_rate=0.1,
+                      weight_decay=0.5)
+        assert out.mean() < 3.0
+
+    def test_others_converge(self):
+        for cls, kw in [
+            (paddle.optimizer.Adagrad, dict(learning_rate=0.5)),
+            (paddle.optimizer.RMSProp, dict(learning_rate=0.05)),
+            (paddle.optimizer.Adamax, dict(learning_rate=0.2)),
+            (paddle.optimizer.Lamb, dict(learning_rate=0.05)),
+        ]:
+            out = run_opt(cls, steps=300, **kw)
+            assert np.allclose(out, 3.0, atol=0.5), (cls.__name__, out)
+
+    def test_adam_matches_reference_math(self):
+        # one Adam step against hand computation
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w * 2.0).sum().backward()  # grad = 2
+        opt.step()
+        # m=0.2 v=0.004*... manual: m_hat=2, v_hat=4, upd = 0.1*2/(2+eps)=0.1
+        assert abs(float(np_t(w)) - 0.9) < 1e-5
+
+    def test_state_dict(self):
+        w = paddle.Parameter(np.zeros(4, np.float32))
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        ((w - 1) ** 2).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        assert "accumulators" in sd and sd["accumulators"]["moment1"]
+
+    def test_grad_clip_global_norm(self):
+        w = paddle.Parameter(np.zeros(4, np.float32))
+        opt = paddle.optimizer.SGD(
+            learning_rate=1.0, parameters=[w],
+            grad_clip=nn.ClipGradByGlobalNorm(0.1))
+        ((w - 100) ** 2).sum().backward()
+        opt.step()
+        # update magnitude bounded by clip_norm * lr
+        assert np.linalg.norm(np_t(w)) <= 0.11
+
+    def test_multi_precision_master_weights(self):
+        w = paddle.Parameter(np.zeros(4, np.float32))
+        w._data = w._data.astype("bfloat16")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=[w],
+                                     multi_precision=True)
+        ((w.astype("float32") - 3) ** 2).sum().backward()
+        opt.step()
+        assert id(w) in opt._master_weights
+        assert str(opt._master_weights[id(w)].dtype) == "float32"
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sch = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(sch())
+            sch.step()
+        assert np.allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_warmup_cosine(self):
+        sch = paddle.optimizer.lr.CosineAnnealingWithWarmupDecay(
+            max_lr=1.0, min_lr=0.1, warmup_step=10, decay_step=100)
+        vals = []
+        for _ in range(101):
+            vals.append(sch())
+            sch.step()
+        assert vals[0] == 0.0 or vals[0] < 0.2
+        assert abs(vals[10] - 1.0) < 0.01
+        assert abs(vals[100] - 0.1) < 0.01
+
+    def test_opt_uses_scheduler(self):
+        w = paddle.Parameter(np.zeros(2, np.float32))
+        sch = paddle.optimizer.lr.StepDecay(0.5, step_size=1, gamma=0.1)
+        opt = paddle.optimizer.SGD(learning_rate=sch, parameters=[w])
+        (w.sum()).backward()
+        opt.step()
+        assert np.allclose(np_t(w), -0.5)
+        sch.step()
+        opt.clear_grad()
+        (w.sum()).backward()
+        opt.step()
+        assert np.allclose(np_t(w), -0.55)
+
+    def test_linear_warmup_piecewise(self):
+        sch = paddle.optimizer.lr.LinearWarmup(0.5, 4, 0.0, 0.5)
+        vals = [sch() for _ in range(3) if sch.step() is None]
+        sch2 = paddle.optimizer.lr.PiecewiseDecay([2, 4], [0.1, 0.2, 0.3])
+        assert sch2() == 0.1
+
+
+class TestAMP:
+    def test_auto_cast_bf16(self):
+        lin = nn.Linear(4, 4)
+        x = paddle.randn([2, 4])
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            y = paddle.matmul(x, lin.weight)
+            assert "bfloat16" in str(y.dtype)
+            z = paddle.nn.functional.softmax(y)  # black-ish: stays computed
+        y2 = paddle.matmul(x, lin.weight)
+        assert y2.dtype == np.float32
+
+    def test_grad_scaler_fp16(self):
+        lin = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.01, parameters=lin.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        x = paddle.randn([2, 4])
+        loss = lin(x).mean()
+        scaled = scaler.scale(loss)
+        assert abs(float(scaled.numpy()) / float(loss.numpy()) - 1024) < 1
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        # grads were unscaled before step
+        assert not scaler._found_inf
+
+    def test_scaler_skips_on_inf(self):
+        lin = nn.Linear(2, 2)
+        w_before = np_t(lin.weight).copy()
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        lin.weight.grad = paddle.to_tensor(
+            np.array([[np.inf, 0], [0, 0]], np.float32))
+        lin.bias.grad = paddle.zeros([2])
+        scaler.step(opt)
+        assert np.allclose(np_t(lin.weight), w_before)
+        assert scaler._scale < 4.0  # backed off
+
+    def test_o2_decorate(self):
+        lin = nn.Linear(4, 4)
+        paddle.amp.decorate(lin, level="O2", dtype="bfloat16")
+        assert "bfloat16" in str(lin.weight.dtype)

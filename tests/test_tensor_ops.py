@@ -1,0 +1,188 @@
+"""Op parity tests vs numpy (reference: test/legacy_test/op_test.py OpTest —
+check_output against numpy + check_grad numeric-vs-analytic)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def np_t(x):
+    return np.asarray(x.numpy())
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], "int64").numpy().sum() == 2
+        assert np.allclose(np_t(paddle.full([2, 2], 3.5)), 3.5)
+        assert np_t(paddle.arange(5)).tolist() == [0, 1, 2, 3, 4]
+        assert np.allclose(np_t(paddle.linspace(0, 1, 5)),
+                           np.linspace(0, 1, 5))
+        assert np.allclose(np_t(paddle.eye(3)), np.eye(3))
+
+    def test_to_tensor(self):
+        t = paddle.to_tensor([[1.0, 2.0]])
+        assert t.dtype == np.float32
+        assert t.shape == [1, 2]
+        ti = paddle.to_tensor([1, 2, 3])
+        assert "int" in str(ti.dtype)
+
+    def test_like(self):
+        x = paddle.randn([3, 4])
+        assert paddle.zeros_like(x).shape == [3, 4]
+        assert np.allclose(np_t(paddle.full_like(x, 2.0)), 2.0)
+
+
+class TestMath:
+    def test_elementwise(self):
+        a = paddle.to_tensor([1.0, 2.0, 3.0])
+        b = paddle.to_tensor([4.0, 5.0, 6.0])
+        assert np.allclose(np_t(a + b), [5, 7, 9])
+        assert np.allclose(np_t(a * b), [4, 10, 18])
+        assert np.allclose(np_t(b / a), [4, 2.5, 2])
+        assert np.allclose(np_t(a - b), [-3, -3, -3])
+        assert np.allclose(np_t(a ** 2), [1, 4, 9])
+        assert np.allclose(np_t(paddle.exp(a)), np.exp([1, 2, 3]), rtol=1e-6)
+        assert np.allclose(np_t(paddle.log(a)), np.log([1, 2, 3]), rtol=1e-6)
+        assert np.allclose(np_t(paddle.sqrt(a)), np.sqrt([1, 2, 3]),
+                           rtol=1e-6)
+
+    def test_scalar_broadcast(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        assert np.allclose(np_t(2 * a), [2, 4])
+        assert np.allclose(np_t(1 - a), [0, -1])
+        assert np.allclose(np_t(6 / a), [6, 3])
+
+    def test_reduce(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        assert float(np_t(paddle.sum(x))) == 66
+        assert np.allclose(np_t(paddle.sum(x, axis=0)), [12, 15, 18, 21])
+        assert np.allclose(np_t(paddle.mean(x)), 5.5)
+        assert float(np_t(paddle.max(x))) == 11
+        assert float(np_t(paddle.min(x))) == 0
+        assert np.allclose(np_t(paddle.prod(paddle.to_tensor([2.0, 3.0]))), 6)
+
+    def test_matmul(self):
+        a = paddle.randn([3, 4])
+        b = paddle.randn([4, 5])
+        c = paddle.matmul(a, b)
+        assert np.allclose(np_t(c), np_t(a) @ np_t(b), atol=1e-5)
+        ct = paddle.matmul(a, paddle.randn([5, 4]), transpose_y=True)
+        assert ct.shape == [3, 5]
+
+    def test_cumsum_clip(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        assert np.allclose(np_t(paddle.cumsum(x)), [1, 3, 6])
+        assert np.allclose(np_t(paddle.clip(x, 1.5, 2.5)), [1.5, 2, 2.5])
+
+    def test_einsum(self):
+        a = paddle.randn([2, 3])
+        b = paddle.randn([3, 4])
+        out = paddle.einsum("ij,jk->ik", a, b)
+        assert np.allclose(np_t(out), np_t(a) @ np_t(b), atol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = paddle.arange(24).astype("float32")
+        y = paddle.reshape(x, [2, 3, 4])
+        assert y.shape == [2, 3, 4]
+        z = paddle.transpose(y, [2, 0, 1])
+        assert z.shape == [4, 2, 3]
+        assert paddle.flatten(y, 1).shape == [2, 12]
+
+    def test_concat_split_stack(self):
+        a = paddle.ones([2, 3])
+        b = paddle.zeros([2, 3])
+        c = paddle.concat([a, b], axis=0)
+        assert c.shape == [4, 3]
+        s = paddle.stack([a, b], axis=0)
+        assert s.shape == [2, 2, 3]
+        parts = paddle.split(c, 2, axis=0)
+        assert len(parts) == 2 and parts[0].shape == [2, 3]
+        parts = paddle.split(c, [1, 3], axis=0)
+        assert parts[1].shape == [3, 3]
+
+    def test_squeeze_unsqueeze(self):
+        x = paddle.ones([1, 3, 1])
+        assert paddle.squeeze(x).shape == [3]
+        assert paddle.squeeze(x, 0).shape == [3, 1]
+        assert paddle.unsqueeze(x, 0).shape == [1, 1, 3, 1]
+
+    def test_gather_scatter(self):
+        x = paddle.to_tensor(np.arange(10, dtype=np.float32))
+        idx = paddle.to_tensor([1, 3, 5])
+        assert np.allclose(np_t(paddle.gather(x, idx)), [1, 3, 5])
+        upd = paddle.to_tensor([[10.0], [20.0]])
+        base = paddle.zeros([4, 1])
+        out = paddle.scatter(base, paddle.to_tensor([0, 2]), upd)
+        assert np.allclose(np_t(out).reshape(-1), [10, 0, 20, 0])
+
+    def test_indexing(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        assert np.allclose(np_t(x[1]), [4, 5, 6, 7])
+        assert np.allclose(np_t(x[:, 1]), [1, 5, 9])
+        assert float(x[2, 3].numpy()) == 11
+        x[0] = 0.0
+        assert np.allclose(np_t(x)[0], 0)
+
+    def test_where_masked(self):
+        x = paddle.to_tensor([1.0, -2.0, 3.0])
+        out = paddle.where(x > 0, x, paddle.zeros_like(x))
+        assert np.allclose(np_t(out), [1, 0, 3])
+
+    def test_tile_expand(self):
+        x = paddle.ones([1, 3])
+        assert paddle.tile(x, [2, 2]).shape == [2, 6]
+        assert paddle.expand(x, [4, 3]).shape == [4, 3]
+
+
+class TestSearchSort:
+    def test_argmax_sort_topk(self):
+        x = paddle.to_tensor([[3.0, 1.0, 2.0]])
+        assert int(paddle.argmax(x, axis=1).numpy()[0]) == 0
+        s = paddle.sort(x, axis=1)
+        assert np.allclose(np_t(s), [[1, 2, 3]])
+        v, i = paddle.topk(x, 2, axis=1)
+        assert np.allclose(np_t(v), [[3, 2]])
+        assert np_t(i).tolist() == [[0, 2]]
+
+    def test_unique(self):
+        x = paddle.to_tensor([3, 1, 2, 1, 3])
+        u = paddle.unique(x)
+        assert np_t(u).tolist() == [1, 2, 3]
+
+
+class TestLinalg:
+    def test_solve_inv(self):
+        a_np = np.array([[2.0, 0.0], [0.0, 4.0]], np.float32)
+        a = paddle.to_tensor(a_np)
+        inv = paddle.linalg.inv(a)
+        assert np.allclose(np_t(inv), np.linalg.inv(a_np), atol=1e-5)
+        b = paddle.to_tensor([[2.0], [4.0]])
+        x = paddle.linalg.solve(a, b)
+        assert np.allclose(np_t(x), [[1], [1]], atol=1e-5)
+
+    def test_norm_svd(self):
+        x = paddle.to_tensor([[3.0, 4.0]])
+        assert abs(float(paddle.linalg.norm(x).numpy()) - 5.0) < 1e-5
+        u, s, vt = paddle.linalg.svd(paddle.randn([4, 3]))
+        assert s.shape == [3]
+
+
+class TestStat:
+    def test_var_std_median(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0, 4.0])
+        assert abs(float(paddle.var(x).numpy())
+                   - np.var([1, 2, 3, 4], ddof=1)) < 1e-6
+        assert abs(float(paddle.median(x).numpy()) - 2.5) < 1e-6
+
+
+class TestDtype:
+    def test_cast(self):
+        x = paddle.ones([2], "float32")
+        y = x.astype("int32")
+        assert y.dtype == np.int32
+        z = x.astype(paddle.bfloat16)
+        assert "bfloat16" in str(z.dtype)
