@@ -76,7 +76,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, num_k_run, body, (acc0, m0, l0))
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
+    # LSE is materialised as [b, h, s, 1]: a trailing singleton lane dim keeps
+    # the Mosaic block shape (block_q, 1) legal (last dim == array dim; the
+    # sublane dim block_q is 8-divisible), unlike a raw [b, h, s] layout.
+    lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q=128, block_k=128):
@@ -96,11 +99,11 @@ def _flash_fwd(q, k, v, causal, scale, block_q=128, block_k=128):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
         interpret=_INTERPRET[0],
     )(q, k, v)
@@ -113,8 +116,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
     q = q_ref[0, 0].astype(jnp.float32) * scale
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
     block_q = q.shape[0]
     qi = pl.program_id(2)
 
@@ -158,8 +161,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             jnp.float32) * scale
         do = do_ref[0, 0, pl.dslice(start_q * block_q, block_q)].astype(
             jnp.float32)
-        lse = lse_ref[0, 0, pl.dslice(start_q * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.dslice(start_q * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.dslice(start_q * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.dslice(start_q * block_q, block_q), 0]
         s = q @ k.T  # [block_q, block_k]
         if causal:
             q_pos = start_q * block_q + jax.lax.broadcasted_iota(
@@ -191,7 +194,8 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q=128, block_k=128):
     from jax.experimental import pallas as pl
 
     b, h, s, d = q.shape
-    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [b, h, s, 1] — lane-aligned like lse
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -202,8 +206,8 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q=128, block_k=128):
             pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -220,8 +224,8 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q=128, block_k=128):
             pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s), lambda bi, hi, ki: (bi, hi, 0)),
-            pl.BlockSpec((1, 1, s), lambda bi, hi, ki: (bi, hi, 0)),
+            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),

@@ -68,6 +68,30 @@ class TestFlashAttentionKernel:
                 name, np.abs(np.asarray(a) - np.asarray(b)).max())
 
 
+class TestFlashAttentionTPULowering:
+    """Round-1 regression: the kernel passed interpret mode but failed Mosaic
+    lowering on real TPU (illegal LSE BlockSpec).  Cross-lower for the TPU
+    target from the CPU host via jax.export so CI catches lowering errors."""
+
+    def test_kernel_lowers_for_tpu(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.kernels.flash_attention import _flash_attention_bhsd
+
+        b, h, s, d = 2, 12, 1024, 64
+        q = jax.ShapeDtypeStruct((b, h, s, d), jnp.bfloat16)
+
+        def fwd_bwd(q, k, v):
+            out, vjp = jax.vjp(
+                lambda q, k, v: _flash_attention_bhsd(q, k, v, True, 0.125),
+                q, k, v)
+            return out, vjp(out)
+
+        exported = jax.export.export(jax.jit(fwd_bwd), platforms=["tpu"])(
+            q, q, q)
+        assert "tpu" in exported.platforms
+
+
 class TestRMSNormKernel:
     def test_matches_reference(self, interpret_mode):
         import jax.numpy as jnp
