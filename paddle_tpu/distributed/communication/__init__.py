@@ -75,7 +75,8 @@ def get_group(gid=0):
 
 
 def is_initialized():
-    return True
+    from ..env import is_initialized as _env_init
+    return _env_init()
 
 
 class _Task:

@@ -27,8 +27,23 @@ _HYBRID_DEGREES = {"pp": 1, "dp": 1, "sharding": 1, "sep": 1, "mp": 1}
 AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
 
 
+_INITIALIZED = [False]
+
+
 def is_initialized():
-    return True
+    """True once a mesh/parallel env has been built (reference:
+    paddle.distributed.is_initialized — python/paddle/distributed/parallel.py)."""
+    return _INITIALIZED[0] or _GLOBAL_MESH is not None
+
+
+def reset_parallel_env():
+    """Tear down the global mesh + hybrid degrees (test isolation; the
+    reference equivalent is destroying the process groups)."""
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = None
+    for k in _HYBRID_DEGREES:
+        _HYBRID_DEGREES[k] = 1
+    _INITIALIZED[0] = False
 
 
 def init_parallel_env():
@@ -46,6 +61,7 @@ def init_parallel_env():
                 process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
         except Exception:
             pass
+    _INITIALIZED[0] = True
     return ParallelEnv()
 
 

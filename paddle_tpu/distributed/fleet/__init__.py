@@ -57,6 +57,15 @@ def get_hybrid_communicate_group():
     return _FLEET["hcg"]
 
 
+def _reset():
+    """Tear down fleet + the global mesh (test isolation / re-init)."""
+    from ..env import reset_parallel_env
+    _FLEET["initialized"] = False
+    _FLEET["strategy"] = None
+    _FLEET["hcg"] = None
+    reset_parallel_env()
+
+
 def is_initialized():
     return _FLEET["initialized"]
 

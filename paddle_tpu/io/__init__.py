@@ -10,8 +10,9 @@ per step (jnp.asarray) and overlaps with compute thanks to XLA async dispatch.
 from __future__ import annotations
 
 import itertools
-import queue
+
 import threading
+import weakref
 
 import numpy as np
 
@@ -270,44 +271,86 @@ class _PrefetchIter:
     def __init__(self, loader, index_iter):
         self._loader = loader
         self._index_iter = index_iter
-        self._queue = queue.Queue(maxsize=max(2, loader.prefetch_factor))
-        self._done = object()
-        self._threads = []
         self._index_lock = threading.Lock()
         self._stop = threading.Event()
-        n = max(1, loader.num_workers)
-        # ordered fetch: single index stream, workers pull next batch index
-        self._order = 0
-        self._pending = {}
-        self._order_lock = threading.Lock()
         self._seq = itertools.count()
-        for _ in range(n):
-            t = threading.Thread(target=self._worker, daemon=True)
-            t.start()
-            self._threads.append(t)
-        self._emitted = 0
-        self._next_emit = 0
         self._results = {}
         self._cv = threading.Condition()
+        self._next_emit = 0
+        n = max(1, loader.num_workers)
+        self._max_pending = max(2, loader.prefetch_factor) * n
+        self._threads = []
+        # Start workers only after ALL state above exists — they touch
+        # _cv/_results immediately (round-1 deadlock: workers raced a
+        # partially-constructed self, died on AttributeError, and the
+        # consumer waited forever).  Workers hold only a weakref to self so
+        # an abandoned iterator is collectable and its workers exit.
+        wref = weakref.ref(self)
+        for _ in range(n):
+            t = threading.Thread(target=_PrefetchIter._worker_main,
+                                 args=(wref,), daemon=True)
+            t.start()
+            self._threads.append(t)
 
-    def _worker(self):
-        while not self._stop.is_set():
-            with self._index_lock:
-                try:
-                    indices = next(self._index_iter)
-                    seq = next(self._seq)
-                except StopIteration:
+    @staticmethod
+    def _worker_main(wref):
+        strong = wref()
+        if strong is None:
+            return
+        # long-lived primitives; none of these keep the iterator alive
+        cv = strong._cv
+        stop = strong._stop
+        index_lock = strong._index_lock
+        index_iter = strong._index_iter
+        seq_counter = strong._seq
+        del strong
+        try:
+            while not stop.is_set():
+                sampler_err = None
+                with index_lock:
+                    try:
+                        indices = next(index_iter)
+                    except StopIteration:
+                        break
+                    except Exception as e:  # broken batch_sampler: deliver,
+                        sampler_err = e     # don't silently truncate the epoch
+                    seq = next(seq_counter)
+
+                # backpressure: at most _max_pending undelivered batches.
+                # Predicate re-resolves the weakref so a blocked worker never
+                # pins an abandoned iterator.
+                def _ready():
+                    st = wref()
+                    return (st is None or stop.is_set()
+                            or seq - st._next_emit < st._max_pending)
+
+                with cv:
+                    while not cv.wait_for(_ready, timeout=0.5):
+                        pass
+                s = wref()
+                if s is None or stop.is_set():
+                    return
+                if sampler_err is not None:
+                    batch = sampler_err
+                else:
+                    try:
+                        batch = s._fetch(indices)
+                    except Exception as e:  # propagate to the consumer
+                        batch = e
+                with cv:
+                    s._results[seq] = batch
+                    cv.notify_all()
+                if isinstance(batch, Exception):
                     break
-            try:
-                batch = self._fetch(indices)
-            except Exception as e:  # propagate
-                batch = e
-            with self._cv:
-                self._results[seq] = batch
-                self._cv.notify_all()
-        with self._cv:
-            self._results.setdefault("done", None)
-            self._cv.notify_all()
+                del s
+        finally:
+            # unconditional: a worker dying for ANY reason must never leave
+            # the consumer blocked
+            s = wref()
+            if s is not None:
+                with cv:
+                    s._results.setdefault("done", None)
+                    cv.notify_all()
 
     def _fetch(self, indices):
         data = [self._loader.dataset[i] for i in indices]
@@ -320,6 +363,7 @@ class _PrefetchIter:
                 if self._next_emit in self._results:
                     batch = self._results.pop(self._next_emit)
                     self._next_emit += 1
+                    self._cv.notify_all()  # wake backpressured workers
                     if isinstance(batch, Exception):
                         raise batch
                     return batch
@@ -336,6 +380,8 @@ class _PrefetchIter:
 
     def __del__(self):
         self._stop.set()
+        with self._cv:
+            self._cv.notify_all()  # wake backpressured workers to exit
 
 
 class DataLoader:

@@ -248,6 +248,10 @@ class GPTForCausalLM(Layer):
 
             if pp > 1:
                 from ..distributed.pipeline import pipeline_apply
+                if L % pp != 0:
+                    raise ValueError(
+                        f"pipeline parallel requires num_layers ({L}) "
+                        f"divisible by pp degree ({pp})")
                 lpp = L // pp
 
                 def stage_fn(sp, hh):
@@ -261,8 +265,15 @@ class GPTForCausalLM(Layer):
                 # microbatches must divide batch
                 while ids.shape[0] % M != 0 and M > 1:
                     M -= 1
+                if M < 2 * pp:
+                    import warnings
+                    warnings.warn(
+                        f"pipeline microbatches degraded to {M} (batch "
+                        f"{ids.shape[0]} not divisible by {2 * pp}); bubble "
+                        f"fraction increases — prefer batch % {2 * pp} == 0",
+                        RuntimeWarning, stacklevel=2)
                 h = pipeline_apply(stage_fn, stage_params, h, M,
-                                   remat=c.recompute or True)
+                                   remat=bool(c.recompute))
             else:
                 def body(hh, xs):
                     lw, key = xs

@@ -23,6 +23,7 @@ def mesh8():
     strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
     hcg = fleet.init(is_collective=True, strategy=strategy)
     yield hcg
+    fleet._reset()  # don't leak pp=2 topology into other modules
 
 
 class TestTopology:
