@@ -24,7 +24,8 @@ from .communication import (Group, P2POp, ReduceOp, all_gather,  # noqa: F401
                             get_group, irecv, is_initialized, isend,
                             new_group, recv, reduce, reduce_scatter,
                             scatter, send, stream)
-from .engine import DistributedEvalStep, DistributedTrainStep  # noqa: F401
+from .engine import (DistributedEvalStep, DistributedTrainStep,  # noqa: F401
+                     Pipeline1F1BTrainStep)
 from .env import (ParallelEnv, build_mesh, get_mesh, get_rank,  # noqa: F401
                   get_world_size, init_parallel_env, set_mesh)
 from .parallel import DataParallel, fused_allreduce_gradients  # noqa: F401

@@ -157,6 +157,110 @@ class DistributedTrainStep:
         return Tensor._wrap(loss)
 
 
+class Pipeline1F1BTrainStep(DistributedTrainStep):
+    """Compiled train step using the 1F1B pipeline schedule
+    (pipeline.pipeline_value_and_grad) instead of tape backward.
+
+    Reference analogue: PipelineParallel.train_batch →
+    forward_backward_pipeline (fleet/meta_parallel/pipeline_parallel.py:697,
+    459).  The model must provide `pipeline_parts()` (see
+    models/gpt.py:GPTForCausalLM.pipeline_parts).  Gradients flow straight
+    from the schedule into param.grad, then the wrapped optimizer runs — the
+    activation footprint is O(pp) microbatches per stage vs O(M) for
+    jax.grad through the GPipe scan.
+    """
+
+    def __init__(self, model, optimizer, num_microbatches=None, mesh=None,
+                 donate=True, batch_spec=None):
+        super().__init__(model, loss_fn=None, optimizer=optimizer, mesh=mesh,
+                         donate=donate, batch_spec=batch_spec)
+        self.num_microbatches = num_microbatches
+
+    def _make_jit(self, params, buffers, opt_state, args_data):
+        from .pipeline import pipeline_value_and_grad
+        model, opt = self.model, self.optimizer
+        mesh = self.mesh
+        pp = mesh.shape["pp"]
+        if mesh.shape.get("mp", 1) > 1 or mesh.shape.get("sep", 1) > 1:
+            # The 1F1B tick dispatches F/B per stage with lax.cond; XLA
+            # requires every device to execute the same collective sequence,
+            # and GSPMD inserts mp/sep collectives inside the stage body —
+            # diverged branches then deadlock the rendezvous.  TP inside
+            # 1F1B needs a manual-TP stage body (explicit psum layout);
+            # until then use pp_schedule='gpipe' or 'interleaved' with TP.
+            raise NotImplementedError(
+                "Pipeline1F1BTrainStep supports pp x dp/sharding meshes; "
+                "mp/sep degree > 1 requires the GPipe or interleaved "
+                "schedule (GSPMD collectives cannot live in the 1F1B "
+                "per-stage cond dispatch)")
+        ids0, _ = args_data
+        M = self.num_microbatches or max(2 * pp, 1)
+        dp = mesh.shape.get("dp", 1) * mesh.shape.get("sharding", 1)
+        # each microbatch must still shard over the data axes — otherwise
+        # GSPMD reshards inside the schedule's conds (rendezvous deadlock)
+        while M > 1 and (ids0.shape[0] % M != 0
+                         or (ids0.shape[0] // M) % dp != 0):
+            M -= 1
+
+        def step_fn(params, buffers, opt_state, lr, rng_key, args):
+            ids, labels = args
+            bind_layer_state(model, params, buffers)
+            bind_optimizer_state(opt, opt_state)
+            prev_lr = opt._learning_rate
+            opt._learning_rate = lr
+            STATE.tracing_depth += 1
+            try:
+                first_fn, mid_fn, last_fn, sp, ex, names = \
+                    model.pipeline_parts()
+                loss_sum, dsp, dex = pipeline_value_and_grad(
+                    first_fn, mid_fn, last_fn, sp, ex, ids, labels, M,
+                    mesh=mesh)
+                ntok = jnp.asarray(ids.size, jnp.float32)
+                loss = loss_sum / ntok
+                by_name = dict(model.named_parameters())
+                for n in names:
+                    p = by_name[n]
+                    g = dsp[n].reshape(p._data.shape) / ntok
+                    p.grad = Tensor._wrap(g.astype(p._data.dtype))
+                for key, pname in (("wte", "wte"), ("lnf_w", "lnf_w"),
+                                   ("lnf_b", "lnf_b"), ("wpe", "wpe"),
+                                   ("head", "lm_head")):
+                    if key in dex and pname in by_name:
+                        p = by_name[pname]
+                        p.grad = Tensor._wrap(
+                            (dex[key] / ntok).astype(p._data.dtype))
+                opt.step()
+                opt.clear_grad()
+            finally:
+                STATE.tracing_depth -= 1
+                opt._learning_rate = prev_lr
+            new_params = {k: p._data for k, p in model.named_parameters()}
+            new_buffers = {k: b._data for k, b in model.named_buffers()}
+            return loss, new_params, new_buffers, optimizer_state(opt)
+
+        pshard = self._param_shardings()
+        bshard = self._buffer_shardings()
+        oshard_in = self._opt_shardings(opt_state, pshard)
+        repl = NamedSharding(mesh, P())
+        args_shard = jax.tree_util.tree_map(self._data_sharding, args_data)
+        in_shardings = (pshard, bshard, oshard_in, repl, repl, args_shard)
+        lr0 = jnp.zeros((), jnp.float32)
+        key0 = jax.random.key(0)
+        with mesh:
+            out_struct = jax.eval_shape(step_fn, params, buffers, opt_state,
+                                        lr0, key0, args_data)
+        bind_layer_state(self.model, params, buffers)
+        bind_optimizer_state(self.optimizer, opt_state)
+        oshard_out = self._opt_shardings(
+            {"acc": out_struct[3]["acc"], "master": out_struct[3]["master"]},
+            pshard)
+        out_shardings = (repl, pshard, bshard, oshard_out)
+        return jax.jit(step_fn,
+                       in_shardings=in_shardings,
+                       out_shardings=out_shardings,
+                       donate_argnums=(0, 1, 2) if self._donate else ())
+
+
 class DistributedEvalStep:
     """Compiled forward-only step with the same shardings."""
 

@@ -35,30 +35,53 @@ def stack_spec(spec):
 
 
 def pipeline_apply(stage_fn, stage_params, x, num_microbatches, mesh=None,
-                   remat=True):
+                   remat=True, schedule="gpipe", num_chunks=1):
     """Run `stage_fn(params_slice, h) -> h` as a P-stage pipeline.
 
-    stage_params: pytree with leaves stacked [P, ...] (dim0 sharded on 'pp')
+    stage_params: pytree with leaves stacked [P, ...] (dim0 sharded on 'pp');
+                  for schedule='interleaved' leaves are [P*num_chunks, ...]
+                  laid out chunk-major (logical stage l = v*P + p lives at
+                  stacked index l) and stage_fn receives 1/num_chunks of the
+                  layers per call.
     x:            [B, ...] input activations for stage 0 (replicated on 'pp')
     returns:      [B, ...] outputs of the last stage (replicated on 'pp')
+
+    schedule='gpipe':       M+P-1 ticks forward; backward = XLA transpose of
+                            the scan (bubble 2(P-1) stage-units round trip).
+    schedule='interleaved': Megatron virtual-pipeline (reference:
+                            PipelineParallelWithInterleave,
+                            fleet/meta_parallel/pipeline_parallel.py:1010) as
+                            a circular schedule — each device runs V chunks,
+                            ramp waste per tick is at most P-1 CHUNKS, so the
+                            bubble shrinks ~V× at the cost of V× ppermute
+                            payloads.
     """
     mesh = mesh or get_mesh()
     pp = mesh.shape["pp"]
     if pp == 1:
-        params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
-        return stage_fn(params, x)
+        h = x
+        n = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+        for s in range(n):
+            params = jax.tree_util.tree_map(lambda a, _s=s: a[_s],
+                                            stage_params)
+            h = stage_fn(params, h)
+        return h
     from ..core.state import STATE
     if STATE.tracing_depth == 0:
         # eager (uncompiled): run stages sequentially — partial-manual
         # shard_map only exists under jit; semantics are identical
         h = x
-        for s in range(pp):
+        n = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+        for s in range(n):
             params = jax.tree_util.tree_map(lambda a, _s=s: a[_s],
                                             stage_params)
             h = stage_fn(params, h)
         return h
     M = num_microbatches
     body = jax.checkpoint(stage_fn) if remat else stage_fn
+    if schedule == "interleaved" and num_chunks > 1:
+        return _interleaved_apply(body, stage_params, x, M, mesh, pp,
+                                  num_chunks)
 
     def inner(sp, xx):
         p = jax.lax.axis_index("pp")
@@ -94,6 +117,276 @@ def pipeline_apply(stage_fn, stage_params, x, num_microbatches, mesh=None,
     return sm(stage_params, x)
 
 
+def _interleaved_apply(body, stage_params, x, M, mesh, pp, V):
+    """Circular (virtual-pipeline) forward: logical stage l = v*pp + p runs
+    chunk v on device p; activations always hop p -> p+1 on the ring, with a
+    chunk shift at the wrap.  A (device, chunk) pair is dispatched under
+    lax.cond so ramp-up/-down ticks only pay for active chunks — that is the
+    V-fold bubble reduction."""
+    import numpy as np
+
+    # Callers stack in LOGICAL order (stacked[l] = logical stage l); GSPMD
+    # gives device p contiguous rows [p*V, (p+1)*V), so reorder to
+    # device-major: row p*V + v must hold logical stage v*pp + p.
+    perm = np.array([(j % V) * pp + j // V for j in range(V * pp)])
+    stage_params = jax.tree_util.tree_map(lambda a: a[perm], stage_params)
+
+    def inner(sp_stacked, xx):
+        p = jax.lax.axis_index("pp")
+        # local stacked leaves: [V, ...] (chunk-major global [V*pp, ...]
+        # sharded on dim0 over pp → local index v picks logical v*pp+p)
+        b = xx.shape[0]
+        mb = b // M
+        mbs = xx.reshape(M, mb, *xx.shape[1:])
+        zero_h = jnp.zeros_like(mbs[0])
+        out0 = jnp.zeros_like(mbs)
+        acts0 = jnp.zeros((V,) + mbs[0].shape, mbs.dtype)
+
+        LP = V * pp  # logical stages
+
+        def step(carry, t):
+            acts, out = carry
+            # chunk v on device p is logical l = v*pp + p and processes
+            # microbatch m = t - l when 0 <= m < M
+            sends = []
+            new_out = out
+            for v in range(V):
+                l = v * pp + p
+                m = t - l
+                active = (m >= 0) & (m < M)
+                inp = jax.lax.cond(
+                    (p == 0) & (v == 0),
+                    lambda: jax.lax.dynamic_index_in_dim(
+                        mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False),
+                    lambda: acts[v])
+                spv = jax.tree_util.tree_map(lambda a, _v=v: a[_v],
+                                             sp_stacked)
+                y = jax.lax.cond(
+                    active, lambda iv: body(spv, iv), lambda iv: iv, inp)
+                sends.append(y)
+                is_last = (p == pp - 1) & (v == V - 1) & active
+                oclip = jnp.clip(m, 0, M - 1)
+                new_out = new_out.at[oclip].set(
+                    jnp.where(is_last, y, new_out[oclip]))
+            send = jnp.stack(sends)  # [V, mb, ...]
+            recv = jax.lax.ppermute(
+                send, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+            # at the ring wrap (arriving on device 0), chunk v-1's output
+            # feeds chunk v: shift the chunk axis by one
+            shifted = jnp.roll(recv, 1, axis=0)
+            acts = jnp.where(p == 0, shifted, recv)
+            return (acts, new_out), None
+
+        T = M + LP - 1
+        (acts, out), _ = jax.lax.scan(step, (acts0, out0), jnp.arange(T))
+        out = jax.lax.psum(out, "pp")
+        return out.reshape(xx.shape)
+
+    in_param_specs = jax.tree_util.tree_map(lambda a: P("pp"), stage_params)
+    sm = jax.shard_map(inner, mesh=mesh,
+                       in_specs=(in_param_specs, P()),
+                       out_specs=P(), axis_names={"pp"}, check_vma=False)
+    return sm(stage_params, x)
+
+
 def num_stages(mesh=None):
     mesh = mesh or get_mesh()
     return mesh.shape["pp"] if mesh is not None else 1
+
+
+# ---------------------------------------------------------------------------
+# 1F1B — joint forward/backward in ONE compiled scan.
+#
+# Reference analogue: PipelineParallel.forward_backward_pipeline
+# (fleet/meta_parallel/pipeline_parallel.py:459): warmup forwards, then the
+# steady 1F1B alternation, with at most (P - stage) microbatches in flight.
+#
+# TPU-native encoding: per-stage schedules are pure index arithmetic on the
+# scan tick t (P = #stages, M = #microbatches, w_s = P - s in-flight target):
+#     forward  of mb m on stage s at tick  tF = s + m          (m < w_s)
+#                                          tF = 2m + s         (m >= w_s)
+#     backward of mb m on stage s at tick  tB = 2P - 1 - s + 2m
+# tF ticks have parity s, tB parity s+1, so each stage does at most one of
+# {F, B} per tick — dispatched with lax.cond so a device only pays for its
+# own branch.  Activations ride lax.ppermute(+1), gradients ppermute(-1).
+# Total ticks 2(M + P - 1), in-flight activations O(P) per stage (the 1F1B
+# memory property; compiled GPipe via jax.grad holds O(M)).
+#
+# The loss lives INSIDE the pipeline (last_fn on the final stage) — that is
+# what lets backward of microbatch m start before forward of m+1 finishes.
+# ---------------------------------------------------------------------------
+
+
+def _f_sched(P, M, s, t):
+    """(microbatch, active) for a forward step of stage s at tick t."""
+    w = P - s
+    d = t - s
+    m_warm = d
+    warm = (d >= 0) & (d < jnp.minimum(w, M))
+    m_steady = d // 2
+    steady = (d >= 0) & (d % 2 == 0) & (m_steady >= w) & (m_steady < M)
+    m = jnp.where(warm, m_warm, m_steady)
+    return m, warm | steady
+
+
+def _b_sched(P, M, s, t):
+    """(microbatch, active) for a backward step of stage s at tick t."""
+    d = t - (2 * P - 1 - s)
+    m = d // 2
+    return m, (d >= 0) & (d % 2 == 0) & (m < M)
+
+
+def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
+                            inputs, labels, num_microbatches, mesh=None):
+    """Compiled 1F1B training step core.
+
+    first_fn(extras, mb_in) -> h        stage-0 prelude (e.g. embedding)
+    mid_fn(sp_slice, h) -> h            per-stage body (stacked blocks);
+                                        output shape == input shape
+    last_fn(extras, h, mb_labels) -> l  final-stage head + loss (scalar,
+                                        SUM-convention over the microbatch)
+    stage_params: pytree, leaves stacked [P, ...] (dim0 on the 'pp' axis)
+    extras:       pytree, replicated (embedding/head/final-norm weights)
+    inputs/labels: [B, ...] arrays; B must divide into num_microbatches
+
+    Returns (loss_sum_over_batch, d_stage_params, d_extras).
+    """
+    mesh = mesh or get_mesh()
+    Pstages = mesh.shape["pp"]
+    M = int(num_microbatches)
+
+    if Pstages == 1:
+        sp0 = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+
+        def whole(sp, ex, x, y):
+            return last_fn(ex, mid_fn(sp, first_fn(ex, x)), y)
+
+        loss, grads = jax.value_and_grad(whole, argnums=(0, 1))(
+            sp0, extras, inputs, labels)
+        dsp = jax.tree_util.tree_map(lambda a: a[None], grads[0])
+        return loss, dsp, grads[1]
+
+    Q = Pstages + 1  # ring size: overwrite provably later than last use
+
+    def inner(sp_stacked, ex, x, yl):
+        P_ = Pstages
+        p = jax.lax.axis_index("pp")
+        sp = jax.tree_util.tree_map(lambda a: a[0], sp_stacked)
+        b = x.shape[0]
+        mb = b // M
+        mbs = x.reshape(M, mb, *x.shape[1:])
+        lbs = yl.reshape(M, mb, *yl.shape[1:])
+
+        h_sd = jax.eval_shape(lambda m: mid_fn(sp, first_fn(ex, m)), mbs[0])
+        zero_h = jnp.zeros(h_sd.shape, h_sd.dtype)
+        h_buf0 = jnp.zeros((Q,) + h_sd.shape, h_sd.dtype)   # stage inputs
+        y_buf0 = jnp.zeros((Q,) + h_sd.shape, h_sd.dtype)   # last-stage outs
+        dsp0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape[1:], a.dtype), sp_stacked)
+        dex0 = jax.tree_util.tree_map(jnp.zeros_like, ex)
+
+        def tick(carry, t):
+            h_buf, y_buf, act_recv, grad_recv, dsp, dex, loss_sum = carry
+
+            # store the activation received at the end of tick t-1: it is
+            # what stage p-1 forwarded at t-1
+            m_prev, f_prev = _f_sched(P_, M, p - 1, t - 1)
+            keep = f_prev & (p > 0)
+            slot = m_prev % Q
+            h_buf = h_buf.at[slot].set(
+                jnp.where(keep, act_recv, h_buf[slot]))
+
+            # ---------------- forward step ----------------
+            m_f, F_act = _f_sched(P_, M, p, t)
+
+            def do_f(ops):
+                h_buf, y_buf = ops
+                inp = jax.lax.cond(
+                    p == 0,
+                    lambda: first_fn(ex, jax.lax.dynamic_index_in_dim(
+                        mbs, m_f, 0, keepdims=False)).astype(h_sd.dtype),
+                    lambda: h_buf[m_f % Q])
+                y = mid_fn(sp, inp)
+                y_buf = y_buf.at[m_f % Q].set(
+                    jnp.where(p == P_ - 1, y, y_buf[m_f % Q]))
+                return h_buf, y_buf, y
+
+            h_buf, y_buf, send_act = jax.lax.cond(
+                F_act, do_f, lambda ops: (ops[0], ops[1], zero_h),
+                (h_buf, y_buf))
+
+            # ---------------- backward step ----------------
+            m_b, B_act = _b_sched(P_, M, p, t)
+
+            def do_b(ops):
+                grad_in, dsp, dex, loss_sum = ops
+                lb = jax.lax.dynamic_index_in_dim(lbs, m_b, 0,
+                                                  keepdims=False)
+
+                def last_g():
+                    yv = y_buf[m_b % Q]
+                    lv, pull = jax.vjp(
+                        lambda e, yy: last_fn(e, yy, lb), ex, yv)
+                    dex_l, gy = pull(jnp.ones((), lv.dtype))
+                    return gy.astype(h_sd.dtype), dex_l, \
+                        lv.astype(jnp.float32)
+
+                def mid_g():
+                    return grad_in, dex0, jnp.zeros((), jnp.float32)
+
+                gy, dex_c, lv = jax.lax.cond(p == P_ - 1, last_g, mid_g)
+
+                def bwd_first():
+                    mbv = jax.lax.dynamic_index_in_dim(mbs, m_b, 0,
+                                                       keepdims=False)
+                    _, pull = jax.vjp(
+                        lambda s_, e_: mid_fn(s_, first_fn(e_, mbv)
+                                              .astype(h_sd.dtype)), sp, ex)
+                    dsp_c, dex_c2 = pull(gy)
+                    return dsp_c, dex_c2, zero_h
+
+                def bwd_mid():
+                    hin = h_buf[m_b % Q]
+                    _, pull = jax.vjp(lambda s_, hh: mid_fn(s_, hh), sp, hin)
+                    dsp_c, dh = pull(gy)
+                    return dsp_c, dex0, dh.astype(h_sd.dtype)
+
+                dsp_c, dex_c2, send_g = jax.lax.cond(p == 0, bwd_first,
+                                                     bwd_mid)
+                dsp = jax.tree_util.tree_map(jnp.add, dsp, dsp_c)
+                dex = jax.tree_util.tree_map(
+                    lambda a, c1, c2: a + c1 + c2, dex, dex_c, dex_c2)
+                return dsp, dex, loss_sum + lv, send_g
+
+            dsp, dex, loss_sum, send_grad = jax.lax.cond(
+                B_act, do_b,
+                lambda ops: (ops[1], ops[2], ops[3], zero_h),
+                (grad_recv, dsp, dex, loss_sum))
+
+            # neighbor exchange (outside the conds: collectives must be
+            # unconditional under SPMD)
+            act_recv = jax.lax.ppermute(
+                send_act, "pp", [(i, (i + 1) % P_) for i in range(P_)])
+            grad_recv = jax.lax.ppermute(
+                send_grad, "pp", [(i, (i - 1) % P_) for i in range(P_)])
+            return (h_buf, y_buf, act_recv, grad_recv, dsp, dex,
+                    loss_sum), None
+
+        carry0 = (h_buf0, y_buf0, zero_h, zero_h, dsp0, dex0,
+                  jnp.zeros((), jnp.float32))
+        T = 2 * (M + Pstages - 1)
+        carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+        _, _, _, _, dsp, dex, loss_sum = carry
+        loss_sum = jax.lax.psum(loss_sum, "pp")
+        dex = jax.tree_util.tree_map(lambda a: jax.lax.psum(a, "pp"), dex)
+        dsp = jax.tree_util.tree_map(lambda a: a[None], dsp)
+        return loss_sum, dsp, dex
+
+    in_param_specs = jax.tree_util.tree_map(lambda a: P("pp"), stage_params)
+    ex_specs = jax.tree_util.tree_map(lambda a: P(), extras)
+    dsp_specs = jax.tree_util.tree_map(lambda a: P("pp"), stage_params)
+    sm = jax.shard_map(inner, mesh=mesh,
+                       in_specs=(in_param_specs, ex_specs, P(), P()),
+                       out_specs=(P(), dsp_specs, ex_specs),
+                       axis_names={"pp"}, check_vma=False)
+    return sm(stage_params, extras, inputs, labels)

@@ -41,7 +41,8 @@ class GPTConfig:
                  layer_norm_epsilon=1e-5, initializer_range=0.02,
                  use_flash_attention=True, recompute=False,
                  sequence_parallel=False, num_experts=0, moe_every=2,
-                 moe_top_k=2, dtype="float32", tie_word_embeddings=True):
+                 moe_top_k=2, dtype="float32", tie_word_embeddings=True,
+                 pp_schedule="gpipe", virtual_pp_degree=1):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -61,6 +62,11 @@ class GPTConfig:
         self.moe_top_k = moe_top_k
         self.dtype = dtype
         self.tie_word_embeddings = tie_word_embeddings
+        # pipeline schedule: 'gpipe' | 'interleaved' (reference:
+        # pipeline_parallel.py:1010 VPP) | '1f1b' (reference :459; used via
+        # Pipeline1F1BTrainStep, which puts the loss inside the pipeline)
+        self.pp_schedule = pp_schedule
+        self.virtual_pp_degree = virtual_pp_degree
 
     # named sizes from the GPT-3 paper / reference recipes
     @staticmethod
@@ -248,18 +254,21 @@ class GPTForCausalLM(Layer):
 
             if pp > 1:
                 from ..distributed.pipeline import pipeline_apply
-                if L % pp != 0:
+                V = (c.virtual_pp_degree
+                     if c.pp_schedule == "interleaved" else 1)
+                n_stage = pp * max(V, 1)
+                if L % n_stage != 0:
                     raise ValueError(
                         f"pipeline parallel requires num_layers ({L}) "
-                        f"divisible by pp degree ({pp})")
-                lpp = L // pp
+                        f"divisible by pp*virtual_pp ({n_stage})")
+                lpp = L // n_stage
 
                 def stage_fn(sp, hh):
                     def body(hh, lw):
                         return block(hh, (lw, dkey)), None
                     hh, _ = jax.lax.scan(body, hh, sp)
                     return hh
-                stage_params = {n: v.reshape(pp, lpp, *v.shape[1:])
+                stage_params = {n: v.reshape(n_stage, lpp, *v.shape[1:])
                                 for n, v in lws.items()}
                 M = max(2 * pp, 1)
                 # microbatches must divide batch
@@ -273,7 +282,11 @@ class GPTForCausalLM(Layer):
                         f"fraction increases — prefer batch % {2 * pp} == 0",
                         RuntimeWarning, stacklevel=2)
                 h = pipeline_apply(stage_fn, stage_params, h, M,
-                                   remat=bool(c.recompute))
+                                   remat=bool(c.recompute),
+                                   schedule=c.pp_schedule
+                                   if c.pp_schedule == "interleaved"
+                                   else "gpipe",
+                                   num_chunks=max(V, 1))
             else:
                 def body(hh, xs):
                     lw, key = xs
@@ -305,6 +318,73 @@ class GPTForCausalLM(Layer):
                                 ids, wte, lw, lb, *st[:-1], head_w=st[-1]),
                             *args, self.lm_head)
         return apply_op("gpt_forward", fn, *args)
+
+
+    # -- 1F1B pipeline decomposition ----------------------------------------
+    def pipeline_parts(self):
+        """Split the model for the compiled 1F1B schedule
+        (distributed.pipeline.pipeline_value_and_grad): embedding in the
+        first stage, final-norm + head + token-sum CE loss in the last —
+        mirroring the reference's PipelineLayer partition where
+        SharedLayerDesc embeddings and the loss_fn live on the end stages
+        (fleet/meta_parallel/parallel_layers/pp_layers.py:56).
+
+        Returns (first_fn, mid_fn, last_fn, stage_params, extras,
+        grad_names): stage_params leaves are [pp, L/pp, ...]; extras holds
+        the replicated end-stage weights.  Loss convention: SUM over tokens
+        (divide by token count for the mean).
+        """
+        c = self.config
+        pp = hybrid_degrees().get("pp", 1)
+        L = c.num_layers
+        if L % pp != 0:
+            raise ValueError(f"num_layers {L} not divisible by pp {pp}")
+        lpp = L // pp
+        if self.training and c.dropout > 0:
+            raise NotImplementedError(
+                "dropout under the 1F1B schedule needs per-microbatch RNG "
+                "threading; train with dropout=0 or use pp_schedule='gpipe'")
+        names = self._stacked()
+        block = self._block_fn(c, self.training, None)
+        eps = c.layer_norm_epsilon
+        tie = c.tie_word_embeddings
+        use_rope = c.use_rope
+
+        stage_params = {
+            n: getattr(self, n)._data.reshape(
+                pp, lpp, *getattr(self, n)._data.shape[1:])
+            for n in names}
+        extras = {"wte": self.wte._data, "lnf_w": self.lnf_w._data,
+                  "lnf_b": self.lnf_b._data}
+        if not use_rope:
+            extras["wpe"] = self.wpe._data
+        if not tie:
+            extras["head"] = self.lm_head._data
+
+        def first_fn(ex, ids):
+            h = jnp.take(ex["wte"], ids, axis=0)
+            if not use_rope:
+                h = h + jnp.take(ex["wpe"], jnp.arange(ids.shape[1]), axis=0)
+            return h
+
+        def mid_fn(sp, h):
+            def body(hh, lw):
+                return block(hh, (lw, None)), None
+            h, _ = jax.lax.scan(body, h, sp)
+            return h
+
+        def last_fn(ex, h, labels):
+            h = _norm(h, ex["lnf_w"], ex["lnf_b"], eps)
+            w = ex["wte"].T if tie else ex["head"]
+            logits = jnp.matmul(h, w,
+                                precision=matmul_precision()).astype(
+                                    jnp.float32)
+            logp = jax.nn.log_softmax(logits, -1)
+            picked = jnp.take_along_axis(
+                logp, labels[..., None].astype(jnp.int32), -1)[..., 0]
+            return jnp.sum(-picked)
+
+        return first_fn, mid_fn, last_fn, stage_params, extras, names
 
 
 class GPTPretrainingCriterion(Layer):
