@@ -132,15 +132,15 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        inv = 1.0 / self._scale
-        found = False
+        inv = 1.0 / float(self._scale)
+        found = jnp.zeros((), jnp.bool_)
         for p in optimizer._parameter_list or []:
             if p is not None and p.grad is not None:
                 g = p.grad._data
-                p.grad._data = (g.astype(jnp.float32) * inv).astype(g.dtype)
-                if bool(jnp.any(~jnp.isfinite(p.grad._data.astype(jnp.float32)))):
-                    found = True
-        self._found_inf = found
+                g32 = g.astype(jnp.float32) * inv
+                p.grad._data = g32.astype(g.dtype)
+                found = found | jnp.any(~jnp.isfinite(g32))
+        self._found_inf = bool(found)
 
     def step(self, optimizer):
         if not self._enable:
@@ -172,6 +172,37 @@ class GradScaler:
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+
+    # -- traced (in-graph) dynamic loss scaling ------------------------------
+    # The compiled train steps (jit.CompiledTrainStep / distributed engine)
+    # thread this state through the XLA program so fp16 loss scaling runs
+    # without host sync (reference: the found-inf allreduce + update in
+    # amp/grad_scaler.py:619 happens on-device here).
+    def _traced_state(self):
+        return {"scale": jnp.asarray(self._scale, jnp.float32),
+                "good": jnp.asarray(self._good_steps, jnp.int32),
+                "bad": jnp.asarray(self._bad_steps, jnp.int32)}
+
+    def _absorb(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state["good"]
+        self._bad_steps = state["bad"]
+
+    def _traced_update(self, state, found):
+        """Pure function of (state, found_inf) -> new state, traceable."""
+        if not self._dynamic:
+            return state
+        good, bad, scale = state["good"], state["bad"], state["scale"]
+        bad2 = jnp.where(found, bad + 1, jnp.zeros_like(bad))
+        good2 = jnp.where(found, jnp.zeros_like(good), good + 1)
+        dec = found & (bad2 >= self._decr_every)
+        inc = (~found) & (good2 >= self._incr_every)
+        scale2 = jnp.where(
+            dec, jnp.maximum(scale * self._decr_ratio, 1.0),
+            jnp.where(inc, scale * self._incr_ratio, scale))
+        return {"scale": scale2,
+                "good": jnp.where(inc, jnp.zeros_like(good2), good2),
+                "bad": jnp.where(dec, jnp.zeros_like(bad2), bad2)}
 
     def is_enable(self):
         return self._enable

@@ -152,7 +152,7 @@ class Tensor:
     cast = astype
 
     def clone(self):
-        return apply_op("assign", lambda x: x + 0 if False else jnp.copy(x), self)
+        return apply_op("assign", jnp.copy, self)
 
     def detach(self):
         t = Tensor._wrap(self._data)
@@ -204,7 +204,12 @@ class Tensor:
             self.grad = None
 
     clear_grad = clear_gradient
-    zero_ = clear_gradient
+
+    def zero_(self):
+        """In-place fill with zeros (reference: paddle.Tensor.zero_ zeroes the
+        tensor *data*, not the gradient)."""
+        self._data = jnp.zeros_like(self._data)
+        return self
 
     @property
     def is_tensor(self):

@@ -27,7 +27,7 @@ from .env import data_axes, get_mesh
 
 class DistributedTrainStep:
     def __init__(self, model, loss_fn, optimizer, mesh=None, donate=True,
-                 batch_spec=None):
+                 batch_spec=None, scaler=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -36,6 +36,8 @@ class DistributedTrainStep:
         self._struct = None
         self._donate = donate
         self._batch_spec = batch_spec
+        self.scaler = scaler if (scaler is not None
+                                 and scaler.is_enable()) else None
 
     # -- sharding helpers ----------------------------------------------------
     def _param_shardings(self):
@@ -51,12 +53,15 @@ class DistributedTrainStep:
                 for k, _ in self.model.named_buffers()}
 
     def _opt_shardings(self, opt_state, param_shardings):
-        """Optimizer accumulators inherit their parameter's sharding (ZeRO:
-        with a 'sharding' axis in the spec the state is sharded — stage-1/2
-        semantics come from the same spec)."""
+        """Optimizer accumulators inherit their parameter's sharding — or,
+        for ZeRO stage 1/2 (params replicated, state sharded: reference
+        dygraph_sharding_optimizer.py:44), the param's ``_opt_state_spec``
+        recorded by apply_fsdp_annotations(stage<=2)."""
         by_id = {}
         for k, p in self.model.named_parameters():
-            by_id[id(p)] = param_shardings[k]
+            oss = getattr(p, "_opt_state_spec", None)
+            by_id[id(p)] = (NamedSharding(self.mesh, oss) if oss is not None
+                            else param_shardings[k])
         acc = {}
         for name, store in opt_state["acc"].items():
             acc[name] = {}
@@ -79,10 +84,12 @@ class DistributedTrainStep:
 
     # -- compile -------------------------------------------------------------
     def _make_jit(self, params, buffers, opt_state, args_data):
+        from ..jit import _scaled_backward, _skip_select
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
         mesh = self.mesh
+        scaler = self.scaler
 
-        def step_fn(params, buffers, opt_state, lr, rng_key, args):
+        def step_fn(params, buffers, opt_state, lr, rng_key, sstate, args):
             from ..tensor import random as _rnd
             bind_layer_state(model, params, buffers)
             bind_optimizer_state(opt, opt_state)
@@ -97,7 +104,11 @@ class DistributedTrainStep:
                         x, (jax.Array, jax.core.Tracer)) else x, args)
                 STATE.grad_enabled = True
                 loss = loss_fn(model, *wargs)
-                loss.backward()
+                if scaler is not None:
+                    found = _scaled_backward(model, opt, loss, lr,
+                                             sstate["scale"])
+                else:
+                    loss.backward()
                 opt.step()
                 opt.clear_grad()
             finally:
@@ -107,33 +118,43 @@ class DistributedTrainStep:
                 STATE.grad_enabled = prev_grad
             new_params = {k: p._data for k, p in model.named_parameters()}
             new_buffers = {k: b._data for k, b in model.named_buffers()}
-            return loss._data, new_params, new_buffers, optimizer_state(opt)
+            new_opt = optimizer_state(opt)
+            if scaler is not None:
+                new_params = _skip_select(found, params, new_params)
+                new_opt = _skip_select(found, opt_state, new_opt)
+                sstate = scaler._traced_update(sstate, found)
+            return loss._data, new_params, new_buffers, new_opt, sstate
 
         pshard = self._param_shardings()
         bshard = self._buffer_shardings()
         oshard_in = self._opt_shardings(opt_state, pshard)
         repl = NamedSharding(mesh, P())
         args_shard = jax.tree_util.tree_map(self._data_sharding, args_data)
-        in_shardings = (pshard, bshard, oshard_in, repl, repl, args_shard)
+        in_shardings = (pshard, bshard, oshard_in, repl, repl, repl,
+                        args_shard)
 
         # The output opt-state structure may be larger than the input one
         # (accumulators are created lazily on the first step) — discover it
         # with eval_shape, then restore the live objects.
         lr0 = jnp.zeros((), jnp.float32)
         key0 = jax.random.key(0)
+        sstate0 = scaler._traced_state() if scaler is not None else {}
         with mesh:
             out_struct = jax.eval_shape(step_fn, params, buffers, opt_state,
-                                        lr0, key0, args_data)
+                                        lr0, key0, sstate0, args_data)
         bind_layer_state(self.model, params, buffers)
         bind_optimizer_state(self.optimizer, opt_state)
         oshard_out = self._opt_shardings(
             {"acc": out_struct[3]["acc"], "master": out_struct[3]["master"]},
             pshard)
-        out_shardings = (repl, pshard, bshard, oshard_out)
+        out_shardings = (repl, pshard, bshard, oshard_out, repl)
+        donate = ()
+        if self._donate:
+            donate = (1,) if scaler is not None else (0, 1, 2)
         return jax.jit(step_fn,
                        in_shardings=in_shardings,
                        out_shardings=out_shardings,
-                       donate_argnums=(0, 1, 2) if self._donate else ())
+                       donate_argnums=donate)
 
     def __call__(self, *args):
         params, buffers = layer_state(self.model)
@@ -149,11 +170,15 @@ class DistributedTrainStep:
         from ..tensor.random import _DEFAULT_GEN
         rng_key = _DEFAULT_GEN.next_key()
         self.optimizer._step_count += 1
+        sstate = (self.scaler._traced_state() if self.scaler is not None
+                  else {})
         with self.mesh:
-            loss, new_params, new_buffers, new_opt = self._jit(
-                params, buffers, opt_state, lr, rng_key, args_data)
+            loss, new_params, new_buffers, new_opt, new_sstate = self._jit(
+                params, buffers, opt_state, lr, rng_key, sstate, args_data)
         bind_layer_state(self.model, new_params, new_buffers)
         bind_optimizer_state(self.optimizer, new_opt)
+        if self.scaler is not None:
+            self.scaler._absorb(new_sstate)
         return Tensor._wrap(loss)
 
 
@@ -202,7 +227,7 @@ class Pipeline1F1BTrainStep(DistributedTrainStep):
                          or (ids0.shape[0] // M) % dp != 0):
             M -= 1
 
-        def step_fn(params, buffers, opt_state, lr, rng_key, args):
+        def step_fn(params, buffers, opt_state, lr, rng_key, sstate, args):
             ids, labels = args
             bind_layer_state(model, params, buffers)
             bind_optimizer_state(opt, opt_state)
@@ -236,25 +261,26 @@ class Pipeline1F1BTrainStep(DistributedTrainStep):
                 opt._learning_rate = prev_lr
             new_params = {k: p._data for k, p in model.named_parameters()}
             new_buffers = {k: b._data for k, b in model.named_buffers()}
-            return loss, new_params, new_buffers, optimizer_state(opt)
+            return loss, new_params, new_buffers, optimizer_state(opt), sstate
 
         pshard = self._param_shardings()
         bshard = self._buffer_shardings()
         oshard_in = self._opt_shardings(opt_state, pshard)
         repl = NamedSharding(mesh, P())
         args_shard = jax.tree_util.tree_map(self._data_sharding, args_data)
-        in_shardings = (pshard, bshard, oshard_in, repl, repl, args_shard)
+        in_shardings = (pshard, bshard, oshard_in, repl, repl, repl,
+                        args_shard)
         lr0 = jnp.zeros((), jnp.float32)
         key0 = jax.random.key(0)
         with mesh:
             out_struct = jax.eval_shape(step_fn, params, buffers, opt_state,
-                                        lr0, key0, args_data)
+                                        lr0, key0, {}, args_data)
         bind_layer_state(self.model, params, buffers)
         bind_optimizer_state(self.optimizer, opt_state)
         oshard_out = self._opt_shardings(
             {"acc": out_struct[3]["acc"], "master": out_struct[3]["master"]},
             pshard)
-        out_shardings = (repl, pshard, bshard, oshard_out)
+        out_shardings = (repl, pshard, bshard, oshard_out, repl)
         return jax.jit(step_fn,
                        in_shardings=in_shardings,
                        out_shardings=out_shardings,

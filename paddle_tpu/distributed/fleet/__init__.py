@@ -96,7 +96,9 @@ def distributed_model(model):
     strategy = _FLEET["strategy"] or DistributedStrategy()
     deg = hybrid_degrees()
     if deg.get("sharding", 1) > 1:
-        apply_fsdp_annotations(model)
+        stage = strategy.hybrid_configs.get("sharding_configs", {}).get(
+            "stage", 3)
+        apply_fsdp_annotations(model, stage=stage)
     return model
 
 

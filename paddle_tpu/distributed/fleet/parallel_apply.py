@@ -31,7 +31,18 @@ def _fsdp_spec(shape, degree):
 
 
 def apply_fsdp_annotations(model, stage=3, min_size=1024):
-    """Annotate parameters with 'sharding'-axis specs (stage-3 semantics)."""
+    """Annotate parameters per the ZeRO ``stage``.
+
+    stage 1/2 (reference DygraphShardingOptimizer / GroupShardedStage2):
+      parameters stay replicated; only the optimizer state (moments, master
+      weights) is sharded over the 'sharding' axis — recorded on the param as
+      ``_opt_state_spec`` and honored by the compiled step's accumulator
+      shardings.  (Stage 2's grad sharding is the reduce-scatter GSPMD
+      already emits for the sharded accumulator update — ephemeral inside
+      the one-program step, so stages 1 and 2 compile identically.)
+    stage 3 (GroupShardedStage3:85): the parameters themselves are sharded;
+      GSPMD all-gathers weights before use and reduce-scatters grads.
+    """
     degree = hybrid_degrees().get("sharding", 1)
     if degree <= 1:
         return model
@@ -42,5 +53,10 @@ def apply_fsdp_annotations(model, stage=3, min_size=1024):
         if int(np.prod(p.shape or [1])) < min_size:
             annotate_param(p, P())
             continue
-        annotate_param(p, _fsdp_spec(p.shape, degree))
+        spec = _fsdp_spec(p.shape, degree)
+        if stage >= 3:
+            annotate_param(p, spec)
+        else:
+            annotate_param(p, P())
+            p._opt_state_spec = spec
     return model

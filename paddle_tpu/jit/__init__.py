@@ -161,6 +161,49 @@ class ignore_module:
         pass
 
 
+# ---------------------------------------------------------------------------
+# Traced dynamic loss scaling inside the one-program train step.
+#
+# Reference analogue: GradScaler.step (amp/grad_scaler.py:619) — unscale,
+# cross-rank found-inf reduction, conditional optimizer step, scale update.
+# Here the whole sequence is part of the XLA program: found_inf is a traced
+# scalar; the "skip" is realised by (a) zeroing the gradients and the lr so
+# lazily-created accumulators (fp32 master weights, moments) keep their init
+# values, and (b) selecting the pre-step value for every state leaf that
+# existed before the update.
+# ---------------------------------------------------------------------------
+def _scaled_backward(model, opt, loss, lr, scale):
+    """Scaled backward + in-graph unscale.  Returns found_inf (traced bool)
+    and sets opt lr to 0 on overflow so the update is a no-op."""
+    (loss * Tensor._wrap(scale.astype(loss._data.dtype))).backward()
+    inv = 1.0 / scale
+    found = jnp.zeros((), jnp.bool_)
+    grads = []
+    for _, p in model.named_parameters():
+        if p.grad is not None:
+            g32 = p.grad._data.astype(jnp.float32) * inv
+            found = found | jnp.any(~jnp.isfinite(g32))
+            grads.append((p, g32))
+    for p, g32 in grads:
+        safe = jnp.where(found, jnp.zeros_like(g32), g32)
+        p.grad._data = safe.astype(p.grad._data.dtype)
+    opt._learning_rate = jnp.where(found, jnp.zeros_like(lr), lr)
+    return found
+
+
+def _skip_select(found, old, new):
+    """Leaf-wise jnp.where(found, old, new) over (possibly nested) dicts;
+    leaves with no pre-step counterpart keep their new (= init) value."""
+    if isinstance(new, dict):
+        return {k: _skip_select(found,
+                                old.get(k) if isinstance(old, dict) else None,
+                                v)
+                for k, v in new.items()}
+    if old is None or not hasattr(new, "dtype"):
+        return new
+    return jnp.where(found, old, new)
+
+
 class CompiledTrainStep:
     """One-XLA-program train step: forward + tape backward + optimizer update,
     compiled together with parameter/optimizer-state donation.
@@ -168,20 +211,27 @@ class CompiledTrainStep:
     This is the TPU replacement for the reference's whole static-graph
     training path (Program + StandaloneExecutor + fused optimizer ops,
     SURVEY §3.3) and the primary perf surface of the framework.
+
+    With ``scaler`` (an enabled amp.GradScaler), fp16 dynamic loss scaling
+    runs in-graph: scaled backward, traced found-inf, skipped update, scale
+    adjustment — zero host round-trips (reference: amp/grad_scaler.py:619).
     """
 
     def __init__(self, model, loss_fn, optimizer, scaler=None, donate=True):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self.scaler = scaler if (scaler is not None
+                                 and scaler.is_enable()) else None
         self._jit = None
         self._struct = None
         self._donate = donate
 
     def _make_jit(self):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        scaler = self.scaler
 
-        def step_fn(params, buffers, opt_state, lr, rng_key, args):
+        def step_fn(params, buffers, opt_state, lr, rng_key, sstate, args):
             from ..tensor import random as _rnd
             bind_layer_state(model, params, buffers)
             bind_optimizer_state(opt, opt_state)
@@ -196,7 +246,11 @@ class CompiledTrainStep:
                         x, (jax.Array, jax.core.Tracer)) else x, args)
                 STATE.grad_enabled = True
                 loss = loss_fn(model, *wargs)
-                loss.backward()
+                if scaler is not None:
+                    found = _scaled_backward(model, opt, loss, lr,
+                                             sstate["scale"])
+                else:
+                    loss.backward()
                 opt.step()
                 opt.clear_grad()
             finally:
@@ -207,10 +261,18 @@ class CompiledTrainStep:
             new_params = {k: p._data for k, p in model.named_parameters()}
             new_buffers = {k: b._data for k, b in model.named_buffers()}
             new_opt = optimizer_state(opt)
-            return loss._data, new_params, new_buffers, new_opt
+            if scaler is not None:
+                new_params = _skip_select(found, params, new_params)
+                new_opt = _skip_select(found, opt_state, new_opt)
+                sstate = scaler._traced_update(sstate, found)
+            return loss._data, new_params, new_buffers, new_opt, sstate
 
-        return jax.jit(step_fn,
-                       donate_argnums=(0, 1, 2) if self._donate else ())
+        donate = ()
+        if self._donate:
+            # with a scaler the pre-step params/opt-state feed the skip
+            # select, so only buffers are donatable
+            donate = (1,) if scaler is not None else (0, 1, 2)
+        return jax.jit(step_fn, donate_argnums=donate)
 
     def __call__(self, *args):
         params, buffers = layer_state(self.model)
@@ -226,10 +288,14 @@ class CompiledTrainStep:
         self.optimizer._step_count += 1
         from ..tensor.random import _DEFAULT_GEN
         rng_key = _DEFAULT_GEN.next_key()
-        loss, new_params, new_buffers, new_opt = self._jit(
-            params, buffers, opt_state, lr, rng_key, args_data)
+        sstate = (self.scaler._traced_state() if self.scaler is not None
+                  else {})
+        loss, new_params, new_buffers, new_opt, new_sstate = self._jit(
+            params, buffers, opt_state, lr, rng_key, sstate, args_data)
         bind_layer_state(self.model, new_params, new_buffers)
         bind_optimizer_state(self.optimizer, new_opt)
+        if self.scaler is not None:
+            self.scaler._absorb(new_sstate)
         if isinstance(self.optimizer._learning_rate, object) and hasattr(
                 self.optimizer._learning_rate, "step"):
             pass  # scheduler stepped by user (paddle semantics)
