@@ -1,87 +1,203 @@
-"""Distributed checkpoint with reshard-on-load (reference:
-python/paddle/distributed/checkpoint/save_state_dict.py:104 — per-rank unique
-shards + global metadata; load_state_dict.py reshards onto the new mesh).
+"""Sharded distributed checkpoint with a global metadata index and
+reshard-on-load.
 
-TPU-native: backed by Orbax (async multi-host checkpoint, the production TPU
-checkpoint stack); falls back to numpy shard files when Orbax is unavailable.
-Loading re-places arrays per the *current* mesh/sharding annotations —
-reshard-on-load for free via jax.device_put."""
+Reference analogue: python/paddle/distributed/checkpoint/save_state_dict.py:104
+(every rank writes its unique local shards, dedup via dist attr),
+metadata.py (global chunk index), load_state_dict.py (reshard onto the
+current, possibly different, mesh topology).
+
+TPU-native design: shards are read straight off the ``jax.Array`` —
+``addressable_shards`` gives (index, replica_id, data); a shard is written
+exactly once globally by keeping only ``replica_id == 0`` chunks, which is
+the dedup-by-dist-attr of the reference.  Loading assembles each device's
+required slice from the saved chunk boxes via
+``jax.make_array_from_callback`` under the *target* sharding — resharding
+across topologies (e.g. save on pp2×mp2×dp2, load on dp8) is just slicing
+arithmetic, no collective needed.
+"""
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 
 import jax
 import numpy as np
 
 from ...core.tensor import Tensor
 
+_ASYNC_THREADS = []
 
-def _to_numpy_state(state_dict):
-    out = {}
+
+def _flatten(state_dict, prefix=""):
+    flat = {}
     for k, v in state_dict.items():
-        if isinstance(v, Tensor):
-            out[k] = np.asarray(v._data)
-        elif isinstance(v, dict):
-            out[k] = _to_numpy_state(v)
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flat.update(_flatten(v, key + "/"))
         else:
-            out[k] = v
-    return out
+            flat[key] = v
+    return flat
+
+
+def _local_unique_chunks(arr):
+    """[(offset, chunk_shape, ndarray)] for shards this process must write.
+
+    ``replica_id == 0`` keeps exactly one copy of each distinct slice
+    globally (the dedup of reference save_state_dict.py:104): replicated
+    arrays are written only by the first replica's owner.
+    """
+    chunks = []
+    for shard in arr.addressable_shards:
+        if shard.replica_id != 0:
+            continue
+        offset = []
+        for s, dim in zip(shard.index, arr.shape):
+            offset.append(int(s.start or 0))
+        if not arr.shape:  # scalar
+            offset = []
+        chunks.append((tuple(offset), tuple(shard.data.shape),
+                       np.asarray(shard.data)))
+    return chunks
+
+
+def wait_async_save():
+    """Block until pending async checkpoint writes finish."""
+    while _ASYNC_THREADS:
+        _ASYNC_THREADS.pop().join()
 
 
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
-    """reference: checkpoint/save_state_dict.py:104."""
+    """Write this process's unique shards + a per-rank metadata index.
+
+    Layout: ``{rank}_0.distcp.npz`` holding chunk arrays keyed
+    ``<tensor>##<chunk>`` and ``{rank}.metadata.json`` describing every
+    chunk box (offset/shape/file/key).  ``load_state_dict`` merges all
+    metadata files, so no cross-process gather is needed at save time.
+    """
+    wait_async_save()
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
-    flat = _to_numpy_state(state_dict)
-    shard_file = os.path.join(path, f"{rank}_0.distcp.npz")
+    flat = _flatten(state_dict)
+    shard_file = f"{rank}_0.distcp.npz"
     arrays = {}
-    meta = {"tensors": {}, "world_size": jax.process_count()}
+    meta = {"world_size": jax.process_count(), "tensors": {}}
     for k, v in flat.items():
-        if isinstance(v, np.ndarray):
-            arrays[k] = v
-            meta["tensors"][k] = {"shape": list(v.shape),
-                                  "dtype": str(v.dtype),
-                                  "file": os.path.basename(shard_file)}
+        if isinstance(v, Tensor):
+            v = v._data
+        if isinstance(v, (jax.Array, np.ndarray)):
+            if isinstance(v, np.ndarray):
+                v = jax.device_put(v)
+            entry = {"shape": list(v.shape), "dtype": str(v.dtype),
+                     "chunks": []}
+            for i, (offset, cshape, data) in enumerate(
+                    _local_unique_chunks(v)):
+                key = f"{k}##{i}"
+                arrays[key] = data
+                entry["chunks"].append({"offset": list(offset),
+                                        "shape": list(cshape),
+                                        "file": shard_file, "key": key})
+            meta["tensors"][k] = entry
         else:
             meta["tensors"][k] = {"value": v if not isinstance(
                 v, np.generic) else v.item()}
-    np.savez(shard_file, **{k: v for k, v in arrays.items()})
-    if rank == coordinator_rank:
-        with open(os.path.join(path, "metadata.json"), "w") as f:
+
+    def _write():
+        np.savez(os.path.join(path, shard_file), **arrays)
+        with open(os.path.join(path, f"{rank}.metadata.json"), "w") as f:
             json.dump(meta, f)
+
+    if async_save:
+        t = threading.Thread(target=_write, daemon=True)
+        t.start()
+        _ASYNC_THREADS.append(t)
+    else:
+        _write()
+
+
+def _read_metadata(path):
+    merged = {}
+    files = sorted(f for f in os.listdir(path) if f.endswith("metadata.json"))
+    if not files:
+        raise FileNotFoundError(f"no checkpoint metadata under {path}")
+    for fname in files:
+        with open(os.path.join(path, fname)) as f:
+            meta = json.load(f)
+        for k, entry in meta["tensors"].items():
+            if k not in merged:
+                merged[k] = entry
+            elif "chunks" in entry:
+                merged[k]["chunks"].extend(entry["chunks"])
+    return merged
+
+
+class _ChunkReader:
+    def __init__(self, path):
+        self.path = path
+        self._files = {}
+
+    def get(self, chunk):
+        fname = chunk["file"]
+        if fname not in self._files:
+            self._files[fname] = np.load(os.path.join(self.path, fname))
+        return self._files[fname][chunk["key"]]
+
+
+def _assemble_slice(index, shape, chunks, reader, dtype):
+    """Fill the box ``index`` (tuple of slices into the global array) from
+    the saved chunk boxes — the reshard-on-load slicing arithmetic."""
+    starts = [s.start or 0 for s in index]
+    stops = [s.stop if s.stop is not None else dim
+             for s, dim in zip(index, shape)]
+    out_shape = [b - a for a, b in zip(starts, stops)]
+    out = np.empty(out_shape, dtype=dtype)
+    filled = np.zeros(out_shape, dtype=bool) if chunks else None
+    for chunk in chunks:
+        coff = chunk["offset"]
+        cshape = chunk["shape"]
+        lo = [max(a, c) for a, c in zip(starts, coff)]
+        hi = [min(b, c + s) for b, c, s in zip(stops, coff, cshape)]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        dst = tuple(slice(l - a, h - a) for l, h, a in zip(lo, hi, starts))
+        src = tuple(slice(l - c, h - c) for l, h, c in zip(lo, hi, coff))
+        out[dst] = reader.get(chunk)[src]
+        filled[dst] = True
+    if filled is not None and not filled.all():
+        raise RuntimeError(
+            "checkpoint is missing chunks for part of the requested slice "
+            "(multi-host checkpoint loaded with too few metadata files?)")
+    return out
 
 
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, offload=False):
-    """reference: checkpoint/load_state_dict.py — fills ``state_dict``
-    in-place, resharding onto current placements."""
-    with open(os.path.join(path, "metadata.json")) as f:
-        meta = json.load(f)
-    cache = {}
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding
-    from ..env import get_mesh
-    for k, tgt in state_dict.items():
-        info = meta["tensors"].get(k)
-        if info is None:
+    """Fill ``state_dict`` in place, resharding saved chunks onto each
+    target tensor's *current* sharding (reference: load_state_dict.py)."""
+    meta = _read_metadata(path)
+    reader = _ChunkReader(path)
+    flat_targets = _flatten(state_dict)
+    for k, tgt in flat_targets.items():
+        info = meta.get(k)
+        if info is None or "value" in info:
             continue
-        if "value" in info:
+        if not isinstance(tgt, Tensor):
             continue
-        fname = os.path.join(path, info["file"])
-        if fname not in cache:
-            cache[fname] = np.load(fname)
-        arr = cache[fname][k]
-        if isinstance(tgt, Tensor):
-            data = jnp.asarray(arr).astype(tgt._data.dtype)
-            mesh = get_mesh()
-            if mesh is not None and tgt.placements is not None:
-                try:
-                    data = jax.device_put(
-                        data, NamedSharding(mesh, tgt.placements))
-                except Exception:
-                    pass
-            tgt._data = data
+        shape = tuple(info["shape"])
+        if tuple(tgt.shape) != shape:
+            raise ValueError(
+                f"checkpoint tensor {k!r} has shape {shape}, target has "
+                f"{tuple(tgt.shape)}")
+        dtype = np.dtype(info["dtype"])
+        sharding = tgt._data.sharding
+        chunks = info["chunks"]
+
+        def cb(index, _chunks=chunks, _shape=shape, _dtype=dtype):
+            return _assemble_slice(index, _shape, _chunks, reader, _dtype)
+
+        arr = jax.make_array_from_callback(shape, sharding, cb)
+        tgt._data = arr.astype(tgt._data.dtype) if str(
+            tgt._data.dtype) != str(dtype) else arr
     return state_dict
