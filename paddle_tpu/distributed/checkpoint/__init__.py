@@ -18,6 +18,7 @@ arithmetic, no collective needed.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -28,6 +29,7 @@ import numpy as np
 from ...core.tensor import Tensor
 
 _ASYNC_THREADS = []
+_ASYNC_ERRORS = []
 
 
 def _flatten(state_dict, prefix=""):
@@ -55,17 +57,23 @@ def _local_unique_chunks(arr):
         offset = []
         for s, dim in zip(shard.index, arr.shape):
             offset.append(int(s.start or 0))
-        if not arr.shape:  # scalar
-            offset = []
         chunks.append((tuple(offset), tuple(shard.data.shape),
                        np.asarray(shard.data)))
     return chunks
 
 
 def wait_async_save():
-    """Block until pending async checkpoint writes finish."""
+    """Block until pending async checkpoint writes finish; re-raise the
+    first write error so a failed save can't masquerade as success."""
     while _ASYNC_THREADS:
         _ASYNC_THREADS.pop().join()
+    if _ASYNC_ERRORS:
+        err = _ASYNC_ERRORS[0]
+        _ASYNC_ERRORS.clear()
+        raise RuntimeError("async checkpoint save failed") from err
+
+
+atexit.register(wait_async_save)  # don't kill a mid-write daemon at exit
 
 
 def save_state_dict(state_dict, path, process_group=None,
@@ -80,6 +88,7 @@ def save_state_dict(state_dict, path, process_group=None,
     wait_async_save()
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
+    world = jax.process_count()
     flat = _flatten(state_dict)
     shard_file = f"{rank}_0.distcp.npz"
     arrays = {}
@@ -89,7 +98,21 @@ def save_state_dict(state_dict, path, process_group=None,
             v = v._data
         if isinstance(v, (jax.Array, np.ndarray)):
             if isinstance(v, np.ndarray):
-                v = jax.device_put(v)
+                # host ndarrays are process-local with no global sharding:
+                # treat as replicated — only the coordinator writes the
+                # (single, full) chunk, so multi-process saves don't emit N
+                # overlapping copies with last-file-wins load order
+                entry = {"shape": list(v.shape), "dtype": str(v.dtype),
+                         "chunks": []}
+                if rank == coordinator_rank:
+                    key = f"{k}##0"
+                    # copy: async save must not race in-place mutation
+                    arrays[key] = v.copy() if async_save else v
+                    entry["chunks"].append(
+                        {"offset": [0] * v.ndim, "shape": list(v.shape),
+                         "file": shard_file, "key": key})
+                meta["tensors"][k] = entry
+                continue
             entry = {"shape": list(v.shape), "dtype": str(v.dtype),
                      "chunks": []}
             for i, (offset, cshape, data) in enumerate(
@@ -105,12 +128,45 @@ def save_state_dict(state_dict, path, process_group=None,
                 v, np.generic) else v.item()}
 
     def _write():
-        np.savez(os.path.join(path, shard_file), **arrays)
-        with open(os.path.join(path, f"{rank}.metadata.json"), "w") as f:
+        import re
+        # stage to tmp names, then clean stale artifacts, then rename into
+        # place — the previous checkpoint stays valid until the new data is
+        # fully on disk (an interrupted async save can't destroy both)
+        shard_tmp = os.path.join(path, shard_file + ".tmp")
+        meta_name = f"{rank}.metadata.json"
+        meta_tmp = os.path.join(path, meta_name + ".tmp")
+        with open(shard_tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(meta_tmp, "w") as f:
             json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(shard_tmp, os.path.join(path, shard_file))
+        os.replace(meta_tmp, os.path.join(path, meta_name))
+        # only AFTER the new files are in place, remove stale artifacts so
+        # a re-save into an existing dir can't mix shards from a previous
+        # (possibly larger-world) checkpoint — and an interrupted save
+        # never leaves the directory with neither checkpoint complete
+        for fname in os.listdir(path):
+            m = re.match(r"^(\d+)(\.metadata\.json|_0\.distcp\.npz)$", fname)
+            owner = int(m.group(1)) if m else None
+            stale = (fname == "metadata.json"  # pre-chunk legacy layout
+                     or (owner is not None and rank == 0 and owner >= world))
+            if stale:
+                try:
+                    os.remove(os.path.join(path, fname))
+                except OSError:
+                    pass
 
     if async_save:
-        t = threading.Thread(target=_write, daemon=True)
+        def _guarded():
+            try:
+                _write()
+            except BaseException as e:  # surfaced by wait_async_save()
+                _ASYNC_ERRORS.append(e)
+        t = threading.Thread(target=_guarded, daemon=True)
         t.start()
         _ASYNC_THREADS.append(t)
     else:
@@ -118,18 +174,28 @@ def save_state_dict(state_dict, path, process_group=None,
 
 
 def _read_metadata(path):
+    import re
     merged = {}
-    files = sorted(f for f in os.listdir(path) if f.endswith("metadata.json"))
+    files = sorted(f for f in os.listdir(path)
+                   if re.match(r"^\d+\.metadata\.json$", f))
     if not files:
         raise FileNotFoundError(f"no checkpoint metadata under {path}")
+    worlds = set()
     for fname in files:
         with open(os.path.join(path, fname)) as f:
             meta = json.load(f)
+        if "world_size" in meta:
+            worlds.add(meta["world_size"])
         for k, entry in meta["tensors"].items():
             if k not in merged:
                 merged[k] = entry
             elif "chunks" in entry:
                 merged[k]["chunks"].extend(entry["chunks"])
+    if len(worlds) > 1 or (worlds and len(files) != next(iter(worlds))):
+        raise RuntimeError(
+            f"checkpoint at {path} has {len(files)} metadata files but "
+            f"records world_size(s) {sorted(worlds)} — incomplete or "
+            f"stale-mixed checkpoint")
     return merged
 
 
@@ -137,12 +203,18 @@ class _ChunkReader:
     def __init__(self, path):
         self.path = path
         self._files = {}
+        self._decoded = {}  # NpzFile re-extracts on every [] access
 
     def get(self, chunk):
-        fname = chunk["file"]
-        if fname not in self._files:
-            self._files[fname] = np.load(os.path.join(self.path, fname))
-        return self._files[fname][chunk["key"]]
+        fname, key = chunk["file"], chunk["key"]
+        if (fname, key) not in self._decoded:
+            if fname not in self._files:
+                self._files[fname] = np.load(os.path.join(self.path, fname))
+            self._decoded[(fname, key)] = self._files[fname][key]
+        return self._decoded[(fname, key)]
+
+    def clear_cache(self):
+        self._decoded.clear()
 
 
 def _assemble_slice(index, shape, chunks, reader, dtype):
@@ -153,7 +225,7 @@ def _assemble_slice(index, shape, chunks, reader, dtype):
              for s, dim in zip(index, shape)]
     out_shape = [b - a for a, b in zip(starts, stops)]
     out = np.empty(out_shape, dtype=dtype)
-    filled = np.zeros(out_shape, dtype=bool) if chunks else None
+    filled = np.zeros(out_shape, dtype=bool)
     for chunk in chunks:
         coff = chunk["offset"]
         cshape = chunk["shape"]
@@ -165,7 +237,7 @@ def _assemble_slice(index, shape, chunks, reader, dtype):
         src = tuple(slice(l - c, h - c) for l, h, c in zip(lo, hi, coff))
         out[dst] = reader.get(chunk)[src]
         filled[dst] = True
-    if filled is not None and not filled.all():
+    if not filled.all():
         raise RuntimeError(
             "checkpoint is missing chunks for part of the requested slice "
             "(multi-host checkpoint loaded with too few metadata files?)")
@@ -176,6 +248,7 @@ def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, offload=False):
     """Fill ``state_dict`` in place, resharding saved chunks onto each
     target tensor's *current* sharding (reference: load_state_dict.py)."""
+    wait_async_save()  # a pending async save to `path` may be mid-write
     meta = _read_metadata(path)
     reader = _ChunkReader(path)
     flat_targets = _flatten(state_dict)
@@ -194,10 +267,18 @@ def load_state_dict(state_dict, path, process_group=None,
         sharding = tgt._data.sharding
         chunks = info["chunks"]
 
-        def cb(index, _chunks=chunks, _shape=shape, _dtype=dtype):
-            return _assemble_slice(index, _shape, _chunks, reader, _dtype)
+        memo = {}  # partially replicated shardings repeat identical indices
+
+        def cb(index, _chunks=chunks, _shape=shape, _dtype=dtype,
+               _memo=memo):
+            key = tuple((s.start, s.stop, s.step) for s in index)
+            if key not in _memo:
+                _memo[key] = _assemble_slice(index, _shape, _chunks, reader,
+                                             _dtype)
+            return _memo[key]
 
         arr = jax.make_array_from_callback(shape, sharding, cb)
         tgt._data = arr.astype(tgt._data.dtype) if str(
             tgt._data.dtype) != str(dtype) else arr
+        reader.clear_cache()  # bound host memory to one tensor's chunks
     return state_dict
