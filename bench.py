@@ -4,8 +4,13 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Baseline anchor (BASELINE.md): the reference publishes no in-repo numbers;
 the driver-defined north star is GPT MFU.  We report tokens/sec/chip for a
-GPT-125M-class model with the compiled train step, plus model FLOPs
-utilization computed from 6*N*T FLOPs/token.
+GPT-125M-class model with the compiled train step; ``vs_baseline`` is true
+model-FLOPs utilisation from 6*N FLOPs/token against the v5e **bf16** peak
+of 197 TFLOP/s (394 TFLOP/s is the int8 number).
+
+Config notes (perf round 4): batch 16 x 1024 with Megatron-style selective
+recompute (saves qkv/attn_out/ffn_up, replays norms+gelu+flash in bwd) beats
+batch 8 without remat; the CE loss is the fused lse-picked form.
 """
 
 import json
@@ -18,7 +23,6 @@ def main():
     import jax
 
     import paddle_tpu as paddle
-    from paddle_tpu.distributed import DistributedTrainStep, fleet
     from paddle_tpu.jit import CompiledTrainStep
     from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
                                    GPTPretrainingCriterion)
@@ -28,8 +32,9 @@ def main():
     if on_tpu:
         cfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
                                   dtype="bfloat16",
-                                  use_flash_attention=True)
-        batch, seq = 8, 1024
+                                  use_flash_attention=True,
+                                  recompute="selective")
+        batch, seq = 16, 1024
     else:  # CPU fallback so the bench always produces a line
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=128,
@@ -52,26 +57,36 @@ def main():
     loss = step(ids, labels)
     loss.numpy()
 
-    iters = 10 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(ids, labels)
-    loss.numpy()  # sync
-    dt = time.perf_counter() - t0
-    tokens_per_sec = batch * seq * iters / dt
+    iters = 15 if on_tpu else 3
+    rounds = 3 if on_tpu else 1
+    rates = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(ids, labels)
+        loss.numpy()  # sync
+        dt = time.perf_counter() - t0
+        rates.append(batch * seq * iters / dt)
+    tokens_per_sec = float(np.median(rates))
+    spread = (float(np.max(rates) - np.min(rates)) / tokens_per_sec
+              if len(rates) > 1 else 0.0)
 
-    # MFU: 6*N FLOPs per token (fwd+bwd) / peak
+    # MFU: 6*N FLOPs per token (fwd+bwd) / bf16 peak
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     flops_per_token = 6 * n_params
-    peak = 394e12 if on_tpu else 1e12  # v5e bf16 peak ~394 TFLOPs
-    mfu = tokens_per_sec * flops_per_token / peak
+    if on_tpu:
+        peak = 197e12  # v5e bf16 peak (394e12 is int8)
+        mfu = tokens_per_sec * flops_per_token / peak
+    else:
+        mfu = 0.0  # CPU fallback: MFU vs TPU peak is meaningless
 
     print(json.dumps({
         "metric": "gpt125m_train_tokens_per_sec_per_chip" if on_tpu
         else "gpt_tiny_cpu_tokens_per_sec",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",
-        "vs_baseline": round(mfu, 4),  # MFU fraction as baseline comparator
+        "vs_baseline": round(mfu, 4),  # true MFU fraction (bf16 peak)
+        "spread_frac": round(spread, 4),
     }))
 
 

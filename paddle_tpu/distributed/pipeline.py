@@ -35,7 +35,8 @@ def stack_spec(spec):
 
 
 def pipeline_apply(stage_fn, stage_params, x, num_microbatches, mesh=None,
-                   remat=True, schedule="gpipe", num_chunks=1):
+                   remat=True, schedule="gpipe", num_chunks=1,
+                   remat_policy=None):
     """Run `stage_fn(params_slice, h) -> h` as a P-stage pipeline.
 
     stage_params: pytree with leaves stacked [P, ...] (dim0 sharded on 'pp');
@@ -78,7 +79,8 @@ def pipeline_apply(stage_fn, stage_params, x, num_microbatches, mesh=None,
             h = stage_fn(params, h)
         return h
     M = num_microbatches
-    body = jax.checkpoint(stage_fn) if remat else stage_fn
+    body = (jax.checkpoint(stage_fn, policy=remat_policy) if remat
+            else stage_fn)
     if schedule == "interleaved" and num_chunks > 1:
         return _interleaved_apply(body, stage_params, x, M, mesh, pp,
                                   num_chunks)

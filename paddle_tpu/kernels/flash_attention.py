@@ -6,6 +6,12 @@ q/k/v blocks stream HBM→VMEM and the two matmuls per tile hit the MXU;
 backward recomputes attention probabilities per tile (flash-attention-2
 style), avoiding O(S^2) residuals.
 
+Perf notes (v5e measurements): Mosaic grid-step overhead is ~2.4us/program,
+so at short sequence lengths a naive (b, h, s/128) grid is overhead-bound —
+attention at GPT-125M shapes was ~65% of forward wall-clock for ~6% of the
+FLOPs.  The kernels therefore process BH heads per grid step (python-unrolled
+head loop) with adaptive q/k block sizes, cutting the program count ~16x.
+
 Layout: [B, S, H, D] (paddle convention) — internally [B, H, S, D].
 """
 
@@ -38,203 +44,280 @@ def reference_attention(q, k, v, causal=False, scale=None):
     return jnp.einsum("bhst,bthd->bshd", p.astype(v.dtype), v)
 
 
+def _round_to_divisor(block, s):
+    """Largest multiple of 128 that is <= block and divides s (s % 128 == 0,
+    so 128 always qualifies) — blocks that don't divide s would silently skip
+    key blocks / leave query rows unwritten."""
+    block = max(128, min(block, s))
+    block -= block % 128
+    while s % block:
+        block -= 128
+    return block
+
+
+def _pick_blocks(h, s, d, itemsize):
+    """(bh, block_q, block_k): heads per program + q/k tile sizes.
+
+    Keeps resident VMEM for bh heads under budget while minimising the
+    program count.  Worst case is the dkv kernel, which holds TWO full-seq
+    arrays (q, do) plus k/v tiles per head group; `itemsize` is the input
+    dtype width (fp32 attention is supported and doubles the footprint).
+    """
+    import os
+    block_q = _round_to_divisor(int(os.environ.get("PTPU_FA_BQ", 1024)), s)
+    block_k = _round_to_divisor(int(os.environ.get("PTPU_FA_BK", 512)), s)
+    bh = 1
+    for cand in (8, 4, 2):
+        if h % cand == 0 and cand * (2 * s * d * itemsize) <= 6 * 1024 * 1024:
+            bh = cand
+            break
+    return bh, block_q, block_k
+
+
+
+def _dot_f32(a, b, ta=False, tb=False):
+    """MXU matmul with fp32 accumulate.  When either operand is 16-bit the
+    other is cast to bf16 too: bf16 x bf16 -> fp32 runs at full MXU rate
+    (fp32 x fp32 runs at ~1/8).  Pure-fp32 inputs keep fp32 operands so
+    fp32 attention stays fp32-accurate."""
+    if a.dtype.itemsize <= 2 or b.dtype.itemsize <= 2:
+        a = a.astype(jnp.bfloat16)
+        b = b.astype(jnp.bfloat16)
+    ca = (1 if not ta else 0,)
+    cb = (0 if not tb else 1,)
+    return jax.lax.dot_general(a, b, ((ca, cb), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_k, seq_len):
+                block_k, seq_len, bh):
     from jax.experimental import pallas as pl
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, d]
-    block_q = q.shape[0]
+    block_q = q_ref.shape[2]
+    d = q_ref.shape[-1]
     qi = pl.program_id(2)
-
-    def body(start_k, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[0, 0, pl.dslice(start_k * block_k, block_k)].astype(jnp.float32)
-        v = v_ref[0, 0, pl.dslice(start_k * block_k, block_k)].astype(jnp.float32)
-        s = q @ k.T  # [block_q, block_k] — MXU
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = start_k * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, -1e30)
-        m_cur = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + p @ v
-        return acc, m_new, l_new
-
     num_k = seq_len // block_k
     if causal:
-        # only key blocks up to (and including) the diagonal participate
         num_k_run = jnp.minimum(num_k, pl.cdiv((qi + 1) * block_q, block_k))
     else:
         num_k_run = num_k
-    acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
-    m0 = jnp.full((block_q,), -1e30, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_k_run, body, (acc0, m0, l0))
-    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-    # LSE is materialised as [b, h, s, 1]: a trailing singleton lane dim keeps
-    # the Mosaic block shape (block_q, 1) legal (last dim == array dim; the
-    # sublane dim block_q is 8-divisible), unlike a raw [b, h, s] layout.
-    lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
+
+    for hh in range(bh):
+        q = q_ref[0, hh]  # [block_q, d] bf16
+
+        def body(start_k, carry):
+            acc, m_prev, l_prev = carry
+            k = k_ref[0, hh, pl.dslice(start_k * block_k, block_k)]
+            v = v_ref[0, hh, pl.dslice(start_k * block_k, block_k)]
+            s = _dot_f32(q, k, tb=True) * scale  # [block_q, block_k] — MXU
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_pos = start_k * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(q_pos >= k_pos, s, -1e30)
+            m_cur = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[:, None] + _dot_f32(p, v)
+            return acc, m_new, l_new
+
+        acc0 = jnp.zeros((block_q, d), jnp.float32)
+        m0 = jnp.full((block_q,), -1e30, jnp.float32)
+        l0 = jnp.zeros((block_q,), jnp.float32)
+        acc, m, l = jax.lax.fori_loop(0, num_k_run, body, (acc0, m0, l0))
+        o_ref[0, hh] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(
+            o_ref.dtype)
+        # LSE materialised as [b, h, s, 1]: trailing singleton lane dim keeps
+        # the Mosaic block shape (block_q, 1) legal.
+        lse_ref[0, hh] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q=128, block_k=128):
+def _flash_fwd(q, k, v, causal, scale):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, h, s, d = q.shape
-    grid = (b, h, s // block_q)
+    bh, block_q, block_k = _pick_blocks(h, s, d, q.dtype.itemsize)
+    grid = (b, h // bh, s // block_q)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=block_k, seq_len=s)
+                               block_k=block_k, seq_len=s, bh=bh)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, bh, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, bh, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, bh, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, bh, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, bh, block_q, 1),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel"),
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_INTERPRET[0],
     )(q, k, v)
     return out, lse
 
 
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   scale, causal, block_k, seq_len):
+                   scale, causal, block_k, seq_len, bh):
     from jax.experimental import pallas as pl
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, 0]
-    delta = delta_ref[0, 0, :, 0]
-    block_q = q.shape[0]
+    block_q = q_ref.shape[2]
+    d = q_ref.shape[-1]
     qi = pl.program_id(2)
-
-    def body(start_k, dq):
-        k = k_ref[0, 0, pl.dslice(start_k * block_k, block_k)].astype(jnp.float32)
-        v = v_ref[0, 0, pl.dslice(start_k * block_k, block_k)].astype(jnp.float32)
-        s = q @ k.T
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = start_k * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, -1e30)
-        p = jnp.exp(s - lse[:, None])
-        dp = do @ v.T
-        ds = p * (dp - delta[:, None])
-        return dq + ds @ k
-
     num_k = seq_len // block_k
     if causal:
         num_k_run = jnp.minimum(num_k, pl.cdiv((qi + 1) * block_q, block_k))
     else:
         num_k_run = num_k
-    dq = jax.lax.fori_loop(0, num_k_run, body,
-                           jnp.zeros((block_q, q.shape[-1]), jnp.float32))
-    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+    for hh in range(bh):
+        q = q_ref[0, hh]
+        do = do_ref[0, hh]
+        lse = lse_ref[0, hh, :, 0]
+        delta = delta_ref[0, hh, :, 0]
+
+        def body(start_k, dq):
+            k = k_ref[0, hh, pl.dslice(start_k * block_k, block_k)]
+            v = v_ref[0, hh, pl.dslice(start_k * block_k, block_k)]
+            s = _dot_f32(q, k, tb=True) * scale
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_pos = start_k * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(q_pos >= k_pos, s, -1e30)
+            p = jnp.exp(s - lse[:, None])
+            dp = _dot_f32(do, v, tb=True)
+            ds = p * (dp - delta[:, None])
+            return dq + _dot_f32(ds, k)
+
+        dq = jax.lax.fori_loop(0, num_k_run, body,
+                               jnp.zeros((block_q, d), jnp.float32))
+        dq_ref[0, hh] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                    dv_ref, *, scale, causal, block_q, seq_len):
+                    dv_ref, *, scale, causal, block_q, seq_len, bh):
     from jax.experimental import pallas as pl
 
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    block_k = k.shape[0]
+    block_k = k_ref.shape[2]
+    d = k_ref.shape[-1]
     ki = pl.program_id(2)
-
-    def body(start_q, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.dslice(start_q * block_q, block_q)].astype(
-            jnp.float32) * scale
-        do = do_ref[0, 0, pl.dslice(start_q * block_q, block_q)].astype(
-            jnp.float32)
-        lse = lse_ref[0, 0, pl.dslice(start_q * block_q, block_q), 0]
-        delta = delta_ref[0, 0, pl.dslice(start_q * block_q, block_q), 0]
-        s = q @ k.T  # [block_q, block_k]
-        if causal:
-            q_pos = start_q * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, -1e30)
-        p = jnp.exp(s - lse[:, None])
-        dv = dv + p.T @ do
-        dp = do @ v.T
-        ds = p * (dp - delta[:, None])
-        # q here is already q*scale, so ds.T @ q == sum_i ds_ij * scale * q_i
-        dk = dk + ds.T @ q
-        return dk, dv
-
     num_q = seq_len // block_q
-    if causal:
-        start = (ki * block_k) // block_q
-    else:
-        start = 0
-    dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
-    dv0 = jnp.zeros((block_k, v.shape[-1]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start if causal else 0, num_q, body, (dk0, dv0))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    start = (ki * block_k) // block_q if causal else 0
+
+    for hh in range(bh):
+        k = k_ref[0, hh]
+        v = v_ref[0, hh]
+
+        def body(start_q, carry):
+            dk, dv = carry
+            q = q_ref[0, hh, pl.dslice(start_q * block_q, block_q)]
+            do = do_ref[0, hh, pl.dslice(start_q * block_q, block_q)]
+            lse = lse_ref[0, hh, pl.dslice(start_q * block_q, block_q), 0]
+            delta = delta_ref[0, hh,
+                              pl.dslice(start_q * block_q, block_q), 0]
+            s = _dot_f32(q, k, tb=True) * scale  # [block_q, block_k]
+            if causal:
+                q_pos = start_q * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(q_pos >= k_pos, s, -1e30)
+            p = jnp.exp(s - lse[:, None])
+            dv = dv + _dot_f32(p, do, ta=True)
+            dp = _dot_f32(do, v, tb=True)
+            ds = p * (dp - delta[:, None])
+            dk = dk + _dot_f32(ds, q, ta=True) * scale
+            return dk, dv
+
+        dk0 = jnp.zeros((block_k, d), jnp.float32)
+        dv0 = jnp.zeros((block_k, d), jnp.float32)
+        dk, dv = jax.lax.fori_loop(start, num_q, body, (dk0, dv0))
+        dk_ref[0, hh] = dk.astype(dk_ref.dtype)
+        dv_ref[0, hh] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q=128, block_k=128):
+def _flash_bwd(q, k, v, out, lse, do, causal, scale):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, h, s, d = q.shape
+    bh, block_q, block_k = _pick_blocks(h, s, d, q.dtype.itemsize)
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [b, h, s, 1] — lane-aligned like lse
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, seq_len=s),
-        grid=(b, h, s // block_q),
+                          block_k=block_k, seq_len=s, bh=bh),
+        grid=(b, h // bh, s // block_q),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, bh, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, bh, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, bh, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, bh, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, bh, block_q, 1),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, bh, block_q, 1),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
+        out_specs=pl.BlockSpec((1, bh, block_q, d),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel"),
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_INTERPRET[0],
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, seq_len=s),
-        grid=(b, h, s // block_k),
+                          block_q=block_q, seq_len=s, bh=bh),
+        grid=(b, h // bh, s // block_k),
         in_specs=[
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, bh, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, bh, block_k, d),
+                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, bh, block_k, d),
+                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, bh, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, bh, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, bh, s, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, bh, block_k, d),
+                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, bh, block_k, d),
+                         lambda bi, hi, ki: (bi, hi, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel"),
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_INTERPRET[0],
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
