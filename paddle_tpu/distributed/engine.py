@@ -206,18 +206,17 @@ class Pipeline1F1BTrainStep(DistributedTrainStep):
         model, opt = self.model, self.optimizer
         mesh = self.mesh
         pp = mesh.shape["pp"]
-        if mesh.shape.get("mp", 1) > 1 or mesh.shape.get("sep", 1) > 1:
-            # The 1F1B tick dispatches F/B per stage with lax.cond; XLA
-            # requires every device to execute the same collective sequence,
-            # and GSPMD inserts mp/sep collectives inside the stage body —
-            # diverged branches then deadlock the rendezvous.  TP inside
-            # 1F1B needs a manual-TP stage body (explicit psum layout);
-            # until then use pp_schedule='gpipe' or 'interleaved' with TP.
+        if mesh.shape.get("sep", 1) > 1:
             raise NotImplementedError(
-                "Pipeline1F1BTrainStep supports pp x dp/sharding meshes; "
-                "mp/sep degree > 1 requires the GPipe or interleaved "
-                "schedule (GSPMD collectives cannot live in the 1F1B "
-                "per-stage cond dispatch)")
+                "Pipeline1F1BTrainStep does not compose with sep>1 yet; "
+                "use pp_schedule='gpipe' with ring attention for long "
+                "sequences")
+        # mp > 1 runs the manual-TP stage body (model._pipeline_parts_tp):
+        # Megatron column/row splits with explicit psum('mp'), vocab-parallel
+        # embedding and parallel CE — GSPMD collectives cannot live in the
+        # 1F1B per-stage cond dispatch, manual ones can because every mp
+        # member of a stage branches identically.
+        tp_axis = "mp" if mesh.shape.get("mp", 1) > 1 else None
         ids0, _ = args_data
         M = self.num_microbatches or max(2 * pp, 1)
         dp = mesh.shape.get("dp", 1) * mesh.shape.get("sharding", 1)
@@ -235,17 +234,22 @@ class Pipeline1F1BTrainStep(DistributedTrainStep):
             opt._learning_rate = lr
             STATE.tracing_depth += 1
             try:
-                first_fn, mid_fn, last_fn, sp, ex, names = \
-                    model.pipeline_parts()
+                first_fn, mid_fn, last_fn, sp, ex, names, specs, fixup = \
+                    model.pipeline_parts(tp_axis=tp_axis)
+                pspecs, especs = specs if specs is not None else (None, None)
                 loss_sum, dsp, dex = pipeline_value_and_grad(
                     first_fn, mid_fn, last_fn, sp, ex, ids, labels, M,
-                    mesh=mesh)
+                    mesh=mesh, param_specs=pspecs, extra_specs=especs,
+                    manual_axes=("pp", tp_axis) if tp_axis else ("pp",))
                 ntok = jnp.asarray(ids.size, jnp.float32)
                 loss = loss_sum / ntok
                 by_name = dict(model.named_parameters())
                 for n in names:
                     p = by_name[n]
-                    g = dsp[n].reshape(p._data.shape) / ntok
+                    g = dsp[n]
+                    if fixup is not None:
+                        g = fixup(n, g)
+                    g = g.reshape(p._data.shape) / ntok
                     p.grad = Tensor._wrap(g.astype(p._data.dtype))
                 for key, pname in (("wte", "wte"), ("lnf_w", "lnf_w"),
                                    ("lnf_b", "lnf_b"), ("wpe", "wpe"),

@@ -1,0 +1,108 @@
+"""Manual tensor-parallel primitives for shard_map stage bodies.
+
+Reference analogue: fleet/layers/mpu/mp_ops.py — `_c_identity` (identity
+forward, all-reduce backward), `_mp_allreduce` (all-reduce forward, identity
+backward), `_c_lookup` (vocab-parallel embedding) and
+ParallelCrossEntropy (mp_layers.py) — the Megatron f/g functions.
+
+These are used where GSPMD cannot be: inside the 1F1B per-stage lax.cond
+dispatch (distributed/pipeline.py), where every collective must be written
+explicitly so all members of the 'mp' group execute the same sequence.
+They only make sense under `jax.shard_map` with the target axis manual.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_mp(x, axis="mp"):
+    """Megatron g: identity forward; all-reduce(grad) backward.
+
+    Place at the input of a column-parallel region: each mp member consumes
+    the same (replicated) x, so the true dx is the sum of the per-member
+    partials."""
+    return x
+
+
+def _copy_fwd(x, axis):
+    return x, None
+
+
+def _copy_bwd(axis, _, g):
+    return (jax.lax.psum(g, axis),)
+
+
+copy_to_mp.defvjp(_copy_fwd, _copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_mp(x, axis="mp"):
+    """Megatron f: all-reduce forward; identity backward.
+
+    Place at the output of a row-parallel matmul: members hold partial sums;
+    the cotangent of the (replicated) output distributes to each partial
+    unchanged."""
+    return jax.lax.psum(x, axis)
+
+
+def _reduce_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _reduce_bwd(axis, _, g):
+    return (g,)
+
+
+reduce_from_mp.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+def vocab_parallel_embedding(ids, wte_local, axis="mp"):
+    """Lookup into a vocab-row-sharded embedding: rows outside this member's
+    range contribute zero; the all-reduce assembles the full vectors.
+    (reference: VocabParallelEmbedding, fleet/layers/mpu/mp_layers.py:60)."""
+    vloc = wte_local.shape[0]
+    off = jax.lax.axis_index(axis) * vloc
+    local = ids - off
+    ok = (local >= 0) & (local < vloc)
+    h = jnp.take(wte_local, jnp.clip(local, 0, vloc - 1), axis=0)
+    h = jnp.where(ok[..., None], h, jnp.zeros_like(h))
+    return reduce_from_mp(h, axis)
+
+
+def vocab_parallel_ce_sum(logits_local, labels, axis="mp"):
+    """Token-sum cross entropy over vocab-column-sharded logits
+    [..., V/mp] without gathering the full vocab axis.
+
+    (reference: ParallelCrossEntropy -> c_softmax_with_cross_entropy_op.cu:
+    two all-reduces — max and sum-exp — plus a masked label pick.)
+
+    Gradient correctness: the max is stop-gradiented (its contribution
+    cancels analytically); psum's transpose is identity, so each member's
+    d(logits_local) = softmax_local - onehot_local, which is exact.
+    """
+    lg = logits_local.astype(jnp.float32)
+    vloc = lg.shape[-1]
+    off = jax.lax.axis_index(axis) * vloc
+    # stop_gradient INSIDE the pmax: its contribution cancels analytically
+    # and pmax has no differentiation rule
+    zmax = jax.lax.pmax(
+        jnp.max(jax.lax.stop_gradient(lg), axis=-1), axis)  # [...]
+    # forward reductions go through reduce_from_mp, NOT raw psum: jax
+    # transposes psum to psum, which would multiply the (replicated)
+    # cotangent by the group size — reduce_from_mp's backward is identity,
+    # which is the correct transpose here.
+    sumexp = reduce_from_mp(
+        jnp.sum(jnp.exp(lg - zmax[..., None]), axis=-1), axis)
+    lse = jnp.log(sumexp) + zmax                           # [...]
+    local = labels - off
+    ok = (local >= 0) & (local < vloc)
+    picked_loc = jnp.take_along_axis(
+        lg, jnp.clip(local, 0, vloc - 1)[..., None].astype(jnp.int32),
+        -1)[..., 0]
+    picked = reduce_from_mp(jnp.where(ok, picked_loc, 0.0), axis)
+    return jnp.sum(lse - picked)

@@ -239,7 +239,9 @@ def _b_sched(P, M, s, t):
 
 
 def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
-                            inputs, labels, num_microbatches, mesh=None):
+                            inputs, labels, num_microbatches, mesh=None,
+                            param_specs=None, extra_specs=None,
+                            manual_axes=("pp",)):
     """Compiled 1F1B training step core.
 
     first_fn(extras, mb_in) -> h        stage-0 prelude (e.g. embedding)
@@ -250,6 +252,14 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
     stage_params: pytree, leaves stacked [P, ...] (dim0 on the 'pp' axis)
     extras:       pytree, replicated (embedding/head/final-norm weights)
     inputs/labels: [B, ...] arrays; B must divide into num_microbatches
+    param_specs/extra_specs: optional PartitionSpec pytrees for manual-TP
+                  stage bodies (weights sharded over e.g. 'mp'; the body
+                  must contain the matching explicit collectives — see
+                  distributed/mp_ops.py).  manual_axes lists every mesh
+                  axis the bodies handle manually; all cond predicates
+                  depend only on the 'pp' coordinate and the tick, so the
+                  members of any other manual axis always branch together
+                  and their collectives rendezvous safely.
 
     Returns (loss_sum_over_batch, d_stage_params, d_extras).
     """
@@ -257,7 +267,7 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
     Pstages = mesh.shape["pp"]
     M = int(num_microbatches)
 
-    if Pstages == 1:
+    if Pstages == 1 and param_specs is None:
         sp0 = jax.tree_util.tree_map(lambda a: a[0], stage_params)
 
         def whole(sp, ex, x, y):
@@ -384,11 +394,12 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
         dsp = jax.tree_util.tree_map(lambda a: a[None], dsp)
         return loss_sum, dsp, dex
 
-    in_param_specs = jax.tree_util.tree_map(lambda a: P("pp"), stage_params)
-    ex_specs = jax.tree_util.tree_map(lambda a: P(), extras)
-    dsp_specs = jax.tree_util.tree_map(lambda a: P("pp"), stage_params)
+    in_param_specs = (param_specs if param_specs is not None else
+                      jax.tree_util.tree_map(lambda a: P("pp"), stage_params))
+    ex_specs = (extra_specs if extra_specs is not None else
+                jax.tree_util.tree_map(lambda a: P(), extras))
     sm = jax.shard_map(inner, mesh=mesh,
                        in_specs=(in_param_specs, ex_specs, P(), P()),
-                       out_specs=(P(), dsp_specs, ex_specs),
-                       axis_names={"pp"}, check_vma=False)
+                       out_specs=(P(), in_param_specs, ex_specs),
+                       axis_names=set(manual_axes), check_vma=False)
     return sm(stage_params, extras, inputs, labels)

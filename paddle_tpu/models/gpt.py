@@ -337,7 +337,7 @@ class GPTForCausalLM(Layer):
 
 
     # -- 1F1B pipeline decomposition ----------------------------------------
-    def pipeline_parts(self):
+    def pipeline_parts(self, tp_axis=None):
         """Split the model for the compiled 1F1B schedule
         (distributed.pipeline.pipeline_value_and_grad): embedding in the
         first stage, final-norm + head + token-sum CE loss in the last —
@@ -345,9 +345,19 @@ class GPTForCausalLM(Layer):
         SharedLayerDesc embeddings and the loss_fn live on the end stages
         (fleet/meta_parallel/parallel_layers/pp_layers.py:56).
 
+        With ``tp_axis`` the stage bodies are MANUAL tensor-parallel over
+        that mesh axis (Megatron column/row split with explicit
+        copy_to_mp/reduce_from_mp, vocab-parallel embedding + parallel CE) —
+        the composition the reference runs as its flagship TP x PP recipe
+        (pipeline_parallel.py:459 with mp_layers).  GSPMD cannot place mp
+        collectives inside the 1F1B per-stage cond dispatch, hence manual.
+
         Returns (first_fn, mid_fn, last_fn, stage_params, extras,
-        grad_names): stage_params leaves are [pp, L/pp, ...]; extras holds
-        the replicated end-stage weights.  Loss convention: SUM over tokens
+        grad_names, specs, grad_fixup): stage_params leaves are
+        [pp, L/pp, ...]; extras holds the end-stage weights.  `specs` is
+        None or (param_specs, extra_specs) PartitionSpec dicts for
+        shard_map; `grad_fixup(name, g)` undoes any weight-layout permutation
+        on the returned gradients.  Loss convention: SUM over tokens
         (divide by token count for the mean).
         """
         c = self.config
@@ -361,10 +371,14 @@ class GPTForCausalLM(Layer):
                 "dropout under the 1F1B schedule needs per-microbatch RNG "
                 "threading; train with dropout=0 or use pp_schedule='gpipe'")
         names = self._stacked()
-        block = self._block_fn(c, self.training, None)
         eps = c.layer_norm_epsilon
         tie = c.tie_word_embeddings
         use_rope = c.use_rope
+
+        if tp_axis is not None:
+            return self._pipeline_parts_tp(tp_axis, pp, lpp)
+
+        block = self._block_fn(c, self.training, None)
 
         stage_params = {
             n: getattr(self, n)._data.reshape(
@@ -395,12 +409,147 @@ class GPTForCausalLM(Layer):
             logits = jnp.matmul(h, w,
                                 precision=matmul_precision()).astype(
                                     jnp.float32)
-            logp = jax.nn.log_softmax(logits, -1)
+            lse = jax.nn.logsumexp(logits, -1)
             picked = jnp.take_along_axis(
-                logp, labels[..., None].astype(jnp.int32), -1)[..., 0]
-            return jnp.sum(-picked)
+                logits, labels[..., None].astype(jnp.int32), -1)[..., 0]
+            return jnp.sum(lse - picked)
 
-        return first_fn, mid_fn, last_fn, stage_params, extras, names
+        return (first_fn, mid_fn, last_fn, stage_params, extras, names,
+                None, None)
+
+    def _pipeline_parts_tp(self, ax, pp, lpp):
+        """Manual-TP stage decomposition (see pipeline_parts docstring)."""
+        import numpy as np
+        from ..distributed.env import get_mesh
+        from ..distributed.mp_ops import (copy_to_mp, reduce_from_mp,
+                                          vocab_parallel_ce_sum,
+                                          vocab_parallel_embedding)
+        c = self.config
+        if c.num_experts > 0:
+            raise NotImplementedError(
+                "MoE blocks under the manual-TP 1F1B path are not supported;"
+                " use incubate.MoELayer with the GSPMD schedules")
+        mesh = get_mesh()
+        mp = mesh.shape[ax]
+        H, nh, F, V = (c.hidden_size, c.num_heads, c.ffn_hidden_size,
+                       c.vocab_size)
+        hd = H // nh
+        if nh % mp or F % mp or V % mp:
+            raise ValueError(
+                f"tensor parallel degree {mp} must divide num_heads {nh}, "
+                f"ffn_hidden {F} and vocab {V}")
+        eps = c.layer_norm_epsilon
+        tie = c.tie_word_embeddings
+        use_rope = c.use_rope
+        use_flash = c.use_flash_attention
+        names = self._stacked()
+
+        # The fused qkv weight is laid out q|k|v along its 3H columns;
+        # column-sharding that directly would give member j a mixed slice.
+        # Permute to shard-major [mp, (q_j|k_j|v_j)] so the LOCAL thirds are
+        # q/k/v (the reference shards q, k, v separately inside
+        # ColumnParallelLinear for the same reason).
+        Hm = H // mp
+        perm = np.concatenate([
+            np.concatenate([np.arange(j * Hm, (j + 1) * Hm) + t * H
+                            for t in range(3)])
+            for j in range(mp)])
+        inv = np.argsort(perm)
+
+        stage_params = {}
+        for n in names:
+            a = getattr(self, n)._data
+            if n == "qkv_w":
+                a = a[:, :, perm]
+            elif n == "qkv_b":
+                a = a[:, perm]
+            stage_params[n] = a.reshape(pp, lpp, *a.shape[1:])
+        extras = {"wte": self.wte._data, "lnf_w": self.lnf_w._data,
+                  "lnf_b": self.lnf_b._data}
+        if not use_rope:
+            extras["wpe"] = self.wpe._data
+        if not tie:
+            extras["head"] = self.lm_head._data
+
+        P_ = P
+        param_specs = {
+            "ln1_w": P_("pp"), "ln1_b": P_("pp"),
+            "qkv_w": P_("pp", None, None, ax),
+            "qkv_b": P_("pp", None, ax),
+            "proj_w": P_("pp", None, ax, None), "proj_b": P_("pp"),
+            "ln2_w": P_("pp"), "ln2_b": P_("pp"),
+            "fc1_w": P_("pp", None, None, ax),
+            "fc1_b": P_("pp", None, ax),
+            "fc2_w": P_("pp", None, ax, None), "fc2_b": P_("pp"),
+        }
+        extra_specs = {"wte": P_(ax, None), "lnf_w": P_(), "lnf_b": P_()}
+        if not use_rope:
+            extra_specs["wpe"] = P_()
+        if not tie:
+            extra_specs["head"] = P_(None, ax)
+
+        def block_tp(h, lw):
+            b, s, _ = h.shape
+            x = _norm(h, lw["ln1_w"], lw["ln1_b"], eps)
+            x = copy_to_mp(x, ax)
+            qkv = jnp.matmul(x, lw["qkv_w"],
+                             precision=matmul_precision()) + lw["qkv_b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            nh_loc = q.shape[-1] // hd
+            q = q.reshape(b, s, nh_loc, hd)
+            k = k.reshape(b, s, nh_loc, hd)
+            v = v.reshape(b, s, nh_loc, hd)
+            if use_rope:
+                from ..kernels.rope import apply_rope
+                q = apply_rope(q)
+                k = apply_rope(k)
+            if use_flash:
+                o = flash_attention_fwd(q, k, v, causal=True)
+            else:
+                o = reference_attention(q, k, v, causal=True)
+            o = o.reshape(b, s, nh_loc * hd)
+            a = reduce_from_mp(
+                jnp.matmul(o, lw["proj_w"], precision=matmul_precision()),
+                ax) + lw["proj_b"]
+            h = h + a
+            x = _norm(h, lw["ln2_w"], lw["ln2_b"], eps)
+            x = copy_to_mp(x, ax)
+            up = jnp.matmul(x, lw["fc1_w"],
+                            precision=matmul_precision()) + lw["fc1_b"]
+            f = reduce_from_mp(
+                jnp.matmul(jax.nn.gelu(up), lw["fc2_w"],
+                           precision=matmul_precision()),
+                ax) + lw["fc2_b"]
+            return h + f
+
+        def first_fn(ex, ids):
+            h = vocab_parallel_embedding(ids, ex["wte"], ax)
+            if not use_rope:
+                h = h + jnp.take(ex["wpe"], jnp.arange(ids.shape[1]), axis=0)
+            return h
+
+        def mid_fn(sp, h):
+            def body(hh, lw):
+                return block_tp(hh, lw), None
+            h, _ = jax.lax.scan(body, h, sp)
+            return h
+
+        def last_fn(ex, h, labels):
+            hn = _norm(h, ex["lnf_w"], ex["lnf_b"], eps)
+            hn = copy_to_mp(hn, ax)
+            w = ex["wte"].T if tie else ex["head"]  # local [H, V/mp]
+            logits = jnp.matmul(hn, w, precision=matmul_precision())
+            return vocab_parallel_ce_sum(logits, labels, ax)
+
+        def grad_fixup(n, g):
+            if n == "qkv_w":
+                return g[..., inv]
+            if n == "qkv_b":
+                return g[..., inv]
+            return g
+
+        return (first_fn, mid_fn, last_fn, stage_params, extras, names,
+                (param_specs, extra_specs), grad_fixup)
 
 
 class GPTPretrainingCriterion(Layer):

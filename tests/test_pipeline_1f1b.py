@@ -55,16 +55,18 @@ class TestScheduleMath:
             # the producing stage one tick earlier
             for m in range(M):
                 assert b_ticks[m] > f_ticks[m]
-        # 1F1B in-flight bound: on stage 0 at most P microbatches have
-        # forwarded but not yet backwarded
-        in_flight = 0
-        max_in_flight = 0
-        events = sorted([(t, +1) for t in f_ticks.values()]
-                        + [(t, -1) for t in b_ticks.values()])
-        for _, d in events:
-            in_flight += d
-            max_in_flight = max(max_in_flight, in_flight)
-        assert max_in_flight <= P + 1
+            # 1F1B in-flight bound PER STAGE: at most P-s+1 microbatches
+            # forwarded but not yet backwarded (stage 0 is the maximum —
+            # this is the memory property that distinguishes 1F1B from
+            # GPipe's O(M))
+            in_flight = 0
+            max_in_flight = 0
+            events = sorted([(t, +1) for t in f_ticks.values()]
+                            + [(t, -1) for t in b_ticks.values()])
+            for _, d in events:
+                in_flight += d
+                max_in_flight = max(max_in_flight, in_flight)
+            assert max_in_flight <= P - s + 1, (s, max_in_flight)
 
     def test_value_and_grad_matches_whole_model(self, mesh_pp2):
         """pipeline_value_and_grad (pp=2, compiled 1F1B) == plain
