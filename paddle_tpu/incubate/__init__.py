@@ -4,6 +4,8 @@ chains."""
 
 from . import nn  # noqa: F401
 from .nn import functional  # noqa: F401
+from .moe import (GShardGate, MoELayer, SwitchGate,  # noqa: F401
+                  moe_capacity, moe_ffn)
 
 
 def autotune(config=None):

@@ -41,7 +41,8 @@ class GPTConfig:
                  layer_norm_epsilon=1e-5, initializer_range=0.02,
                  use_flash_attention=True, recompute=False,
                  sequence_parallel=False, num_experts=0, moe_every=2,
-                 moe_top_k=2, dtype="float32", tie_word_embeddings=True,
+                 moe_top_k=2, moe_capacity_factor=1.25, dtype="float32",
+                 tie_word_embeddings=True,
                  pp_schedule="gpipe", virtual_pp_degree=1):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -60,6 +61,7 @@ class GPTConfig:
         self.num_experts = num_experts
         self.moe_every = moe_every
         self.moe_top_k = moe_top_k
+        self.moe_capacity_factor = moe_capacity_factor
         self.dtype = dtype
         self.tie_word_embeddings = tie_word_embeddings
         # pipeline schedule: 'gpipe' | 'interleaved' (reference:
@@ -125,11 +127,15 @@ class GPTForCausalLM(Layer):
         self.ln2_b = mk((L, H), zeros, P())
         if c.num_experts > 0:
             E = c.num_experts
+            # expert dim shards over 'dp' (EP) only when divisible
+            ep = "dp" if E % max(hybrid_degrees().get("dp", 1), 1) == 0 \
+                else None
             self.gate_w = mk((L, H, E), init, P())
-            self.fc1_w = mk((L, E, H, F), init, P(None, "dp", None, "mp"))
-            self.fc1_b = mk((L, E, F), zeros, P(None, "dp", "mp"))
-            self.fc2_w = mk((L, E, F, H), init, P(None, "dp", "mp", None))
-            self.fc2_b = mk((L, E, H), zeros, P(None, "dp", None))
+            self.fc1_w = mk((L, E, H, F), init, P(None, ep, None, "mp"))
+            self.fc1_b = mk((L, E, F), zeros, P(None, ep, "mp"))
+            self.fc2_w = mk((L, E, F, H), init, P(None, ep, "mp", None))
+            self.fc2_b = mk((L, E, H), zeros, P(None, ep, None))
+            self._moe_ep_spec = ep
         else:
             self.fc1_w = mk((L, H, F), init, P(None, None, "mp"))
             self.fc1_b = mk((L, F), zeros, P(None, "mp"))
@@ -171,32 +177,31 @@ class GPTForCausalLM(Layer):
 
         def ffn(h, lw):
             if c.num_experts > 0:
-                # dense MoE dispatch (EP): experts stacked on an axis sharded
-                # over the data dim; GSPMD turns the einsum into all-to-all
-                logits = jnp.matmul(h, lw["gate_w"])  # [b,s,E]
-                probs = jax.nn.softmax(logits, -1)
-                k = min(c.moe_top_k, c.num_experts)
-                topv, topi = jax.lax.top_k(probs, k)
-                topv = topv / jnp.sum(topv, -1, keepdims=True)
-                gates = jnp.zeros_like(probs)
-                gates = jnp.put_along_axis(gates, topi, topv, axis=-1,
-                                           inplace=False)
-                up = jnp.einsum("bsh,ehf->bsef", h, lw["fc1_w"],
-                                precision=matmul_precision()) + lw["fc1_b"]
-                act = jax.nn.gelu(up)
-                down = jnp.einsum("bsef,efh->bseh", act, lw["fc2_w"],
-                                  precision=matmul_precision()) + lw["fc2_b"]
-                return jnp.einsum("bseh,bse->bsh", down, gates)
+                # real top-k expert dispatch (EP): GShard one-hot
+                # dispatch/combine einsums over a static capacity; the
+                # expert dim is sharded over 'dp', so GSPMD inserts the
+                # token all-to-all (the reference's global_scatter/
+                # global_gather, moe/moe_layer.py:263).  Compute is
+                # O(top_k) per token, not O(E).
+                from ..incubate.moe import moe_ffn
+                return moe_ffn(
+                    h, lw["gate_w"], lw["fc1_w"], lw["fc1_b"],
+                    lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
+                    capacity_factor=c.moe_capacity_factor,
+                    ep_spec=getattr(self, "_moe_ep_spec", None))
             up = jnp.matmul(h, lw["fc1_w"], precision=matmul_precision()) \
                 + lw["fc1_b"]
             up = checkpoint_name(up, "ffn_up")
             act = jax.nn.gelu(up)
-            return jnp.matmul(act, lw["fc2_w"], precision=matmul_precision()) \
-                + lw["fc2_b"]
+            out = jnp.matmul(act, lw["fc2_w"],
+                             precision=matmul_precision()) + lw["fc2_b"]
+            return out, None
 
         drop = c.dropout if training else 0.0
 
         def block(h, lw_and_key):
+            """Returns (h, aux): aux is the MoE load-balancing loss for this
+            layer (None for dense FFN)."""
             lw, key = lw_and_key
             x = _norm(h, lw["ln1_w"], lw["ln1_b"], eps)
             a = attention(x, lw)
@@ -206,7 +211,7 @@ class GPTForCausalLM(Layer):
                               a / (1 - drop), 0.0).astype(a.dtype)
             h = h + a
             x = _norm(h, lw["ln2_w"], lw["ln2_b"], eps)
-            f = ffn(x, lw)
+            f, aux = ffn(x, lw)
             if drop > 0:
                 key, k2 = jax.random.split(key)
                 f = jnp.where(jax.random.bernoulli(k2, 1 - drop, f.shape),
@@ -218,7 +223,7 @@ class GPTForCausalLM(Layer):
                     h = jax.lax.with_sharding_constraint(
                         h, jax.sharding.NamedSharding(
                             mesh, P(("dp", "sharding"), "sep", None)))
-            return h
+            return h, aux
 
         return block
 
@@ -267,8 +272,12 @@ class GPTForCausalLM(Layer):
                 lpp = L // n_stage
 
                 def stage_fn(sp, hh):
+                    # MoE aux loss is dropped on the pipeline path (the
+                    # stage contract carries activations only); use
+                    # moe_aux_loss() with pp=1 meshes
                     def body(hh, lw):
-                        return block(hh, (lw, dkey)), None
+                        hh, _aux = block(hh, (lw, dkey))
+                        return hh, None
                     hh, _ = jax.lax.scan(body, hh, sp)
                     return hh
                 stage_params = {n: v.reshape(n_stage, lpp, *v.shape[1:])
@@ -297,7 +306,9 @@ class GPTForCausalLM(Layer):
             else:
                 def body(hh, xs):
                     lw, key = xs
-                    return block(hh, (lw, key)), None
+                    hh, aux = block(hh, (lw, key))
+                    return hh, (aux if aux is not None
+                                else jnp.zeros((), jnp.float32))
                 scan_body = body
                 if c.recompute == "selective":
                     # Megatron-style selective recompute (reference:
@@ -310,7 +321,7 @@ class GPTForCausalLM(Layer):
                         save_only_these_names("qkv", "attn_out", "ffn_up"))
                 elif c.recompute:
                     scan_body = jax.checkpoint(body)
-                h, _ = jax.lax.scan(scan_body, h, (lws, keys))
+                h, auxs = jax.lax.scan(scan_body, h, (lws, keys))
             h = _norm(h, lnf_w, lnf_b, c.layer_norm_epsilon)
             if c.tie_word_embeddings:
                 logits = jnp.matmul(h, wte.T, precision=matmul_precision())
@@ -322,6 +333,8 @@ class GPTForCausalLM(Layer):
                 logits = jax.lax.with_sharding_constraint(
                     logits, jax.sharding.NamedSharding(
                         mesh, P(("dp", "sharding"), None, "mp")))
+            if c.num_experts > 0 and pp <= 1:
+                return logits, jnp.sum(auxs)
             return logits
 
         args = [input_ids, self.wte, self.lnf_w, self.lnf_b]
@@ -329,11 +342,26 @@ class GPTForCausalLM(Layer):
             args.append(self.wpe)
         args += params
         if not c.tie_word_embeddings:
-            return apply_op("gpt_forward",
-                            lambda ids, wte, lw, lb, *st: fn(
-                                ids, wte, lw, lb, *st[:-1], head_w=st[-1]),
-                            *args, self.lm_head)
-        return apply_op("gpt_forward", fn, *args)
+            out = apply_op("gpt_forward",
+                           lambda ids, wte, lw, lb, *st: fn(
+                               ids, wte, lw, lb, *st[:-1], head_w=st[-1]),
+                           *args, self.lm_head)
+        else:
+            out = apply_op("gpt_forward", fn, *args)
+        if isinstance(out, tuple):
+            logits, self._moe_aux = out
+            return logits
+        self._moe_aux = None
+        return out
+
+    def moe_aux_loss(self):
+        """Summed MoE load-balancing loss from the last forward (0 when the
+        model is dense or the pipeline path dropped it).  Add
+        `model.moe_aux_loss() * coeff` to the training loss (reference
+        trainers do the same with the gate loss)."""
+        if getattr(self, "_moe_aux", None) is None:
+            return Tensor._wrap(jnp.zeros((), jnp.float32))
+        return self._moe_aux
 
 
     # -- 1F1B pipeline decomposition ----------------------------------------
@@ -399,7 +427,8 @@ class GPTForCausalLM(Layer):
 
         def mid_fn(sp, h):
             def body(hh, lw):
-                return block(hh, (lw, None)), None
+                hh, _aux = block(hh, (lw, None))  # aux dropped under pp
+                return hh, None
             h, _ = jax.lax.scan(body, h, sp)
             return h
 
