@@ -40,7 +40,8 @@ class GPTConfig:
                  dropout=0.0, attention_dropout=0.0, use_rope=False,
                  layer_norm_epsilon=1e-5, initializer_range=0.02,
                  use_flash_attention=True, recompute=False,
-                 sequence_parallel=False, num_experts=0, moe_every=2,
+                 sequence_parallel=False, context_parallel=False,
+                 num_experts=0, moe_every=2,
                  moe_top_k=2, moe_capacity_factor=1.25, dtype="float32",
                  tie_word_embeddings=True,
                  pp_schedule="gpipe", virtual_pp_degree=1):
@@ -58,6 +59,10 @@ class GPTConfig:
         self.use_flash_attention = use_flash_attention
         self.recompute = recompute
         self.sequence_parallel = sequence_parallel
+        # context_parallel: shard the SEQUENCE over the 'sep' mesh axis and
+        # run ring attention (kernels/ring_attention.py) — the reference's
+        # segment-parallel long-context capability (segment_parallel.py)
+        self.context_parallel = context_parallel
         self.num_experts = num_experts
         self.moe_every = moe_every
         self.moe_top_k = moe_top_k
@@ -167,7 +172,10 @@ class GPTForCausalLM(Layer):
                 from ..kernels.rope import apply_rope
                 q = apply_rope(q)
                 k = apply_rope(k)
-            if use_flash:
+            if c.context_parallel and hybrid_degrees().get("sep", 1) > 1:
+                from ..kernels.ring_attention import ring_attention
+                o = ring_attention(q, k, v, causal=True)
+            elif use_flash:
                 o = flash_attention_fwd(q, k, v, causal=True)
             else:
                 o = reference_attention(q, k, v, causal=True)
