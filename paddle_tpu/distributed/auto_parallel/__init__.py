@@ -168,10 +168,15 @@ def shard_tensor(data, mesh, placements, dtype=None, place=None,
     spec = _spec_with_names(placements, mesh, t._data.ndim)
     jmesh = mesh.to_jax_mesh()
     if not isinstance(t._data, jax.core.Tracer):
+        # A failed device_put must raise: swallowing it returns a tensor
+        # that LOOKS dist-annotated but is not actually sharded.
         try:
             t._data = jax.device_put(t._data, NamedSharding(jmesh, spec))
-        except Exception:
-            pass  # single-device or incompatible: metadata only
+        except Exception as e:
+            raise ValueError(
+                f"shard_tensor: cannot place shape {tuple(t._data.shape)} "
+                f"with placements {placements} (spec {spec}) on mesh "
+                f"{dict(zip(mesh.dim_names, mesh.shape))}: {e}") from e
     t.is_dist = True
     t.placements = spec
     t.process_mesh = mesh
@@ -199,8 +204,11 @@ def reshard(dist_tensor, mesh, placements):
     else:
         try:
             t._data = jax.device_put(t._data, NamedSharding(jmesh, spec))
-        except Exception:
-            pass
+        except Exception as e:
+            raise ValueError(
+                f"reshard: cannot move shape {tuple(t._data.shape)} to "
+                f"placements {placements} (spec {spec}) on mesh "
+                f"{dict(zip(mesh.dim_names, mesh.shape))}: {e}") from e
     t.is_dist = True
     t.placements = spec
     t.process_mesh = mesh

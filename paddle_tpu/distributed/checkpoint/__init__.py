@@ -76,23 +76,75 @@ def wait_async_save():
 atexit.register(wait_async_save)  # don't kill a mid-write daemon at exit
 
 
+_META_RE = r"^(\d+)\.(\d+)\.metadata\.json$"          # rank.sid.metadata.json
+_LEGACY_META_RE = r"^(\d+)\.metadata\.json$"
+
+
+def _existing_save_ids(path):
+    import re
+    sids = set()
+    for fname in os.listdir(path):
+        m = re.match(_META_RE, fname)
+        if m:
+            sids.add(int(m.group(2)))
+    return sids
+
+
+def _next_save_id(path):
+    sids = _existing_save_ids(path)
+    nxt = (max(sids) + 1) if sids else 0
+    if jax.process_count() > 1:
+        # all ranks must agree on the id; the coordinator's view wins
+        from jax.experimental import multihost_utils
+        nxt = int(multihost_utils.broadcast_one_to_all(
+            np.asarray(nxt, np.int32)))
+    return nxt
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
     """Write this process's unique shards + a per-rank metadata index.
 
-    Layout: ``{rank}_0.distcp.npz`` holding chunk arrays keyed
-    ``<tensor>##<chunk>`` and ``{rank}.metadata.json`` describing every
-    chunk box (offset/shape/file/key).  ``load_state_dict`` merges all
-    metadata files, so no cross-process gather is needed at save time.
+    Layout: ``{rank}_0.{sid}.distcp.npz`` holding chunk arrays keyed
+    ``<tensor>##<chunk>`` and ``{rank}.{sid}.metadata.json`` describing every
+    chunk box (offset/shape/file/key), where ``sid`` is a monotonically
+    increasing save id (``unique_id`` if given).  A save NEVER overwrites a
+    previous save's files — ``load_state_dict`` picks the newest save id
+    with a complete metadata set, so a crash mid-save (even with a changed
+    world size) always leaves the previous checkpoint loadable.  The
+    coordinator garbage-collects older saves only after verifying the new
+    save is complete on shared storage.  (Reference versioning:
+    distributed/checkpoint/save_state_dict.py:104 unique_id dirs.)
     """
     wait_async_save()
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     world = jax.process_count()
+    # clean OWN orphaned tmp files from a previous crashed run
+    for fname in os.listdir(path):
+        if fname.startswith(f"{rank}_0.") and fname.endswith(".tmp") or \
+                fname.startswith(f"{rank}.") and fname.endswith(".tmp"):
+            try:
+                os.remove(os.path.join(path, fname))
+            except OSError:
+                pass
+    if unique_id is not None:
+        sid = int(unique_id)
+        existing = _existing_save_ids(path)
+        if existing and sid <= max(existing):
+            # reusing a sid would overwrite that save's files in place
+            # (breaking crash-safety), and a lower-than-max sid could never
+            # be picked by load (newest complete wins)
+            raise ValueError(
+                f"unique_id={sid} collides with or predates existing save "
+                f"ids {sorted(existing)} at {path}; pass a strictly larger "
+                "id or omit unique_id for auto-increment")
+    else:
+        sid = _next_save_id(path)
     flat = _flatten(state_dict)
-    shard_file = f"{rank}_0.distcp.npz"
+    shard_file = f"{rank}_0.{sid}.distcp.npz"
     arrays = {}
-    meta = {"world_size": jax.process_count(), "tensors": {}}
+    meta = {"world_size": world, "save_id": sid, "tensors": {}}
     for k, v in flat.items():
         if isinstance(v, Tensor):
             v = v._data
@@ -118,7 +170,11 @@ def save_state_dict(state_dict, path, process_group=None,
             for i, (offset, cshape, data) in enumerate(
                     _local_unique_chunks(v)):
                 key = f"{k}##{i}"
-                arrays[key] = data
+                # async save: deep-copy NOW — np.asarray(shard.data) can be
+                # a zero-copy view whose donated buffer the next train step
+                # reuses while the writer thread is still serialising it
+                arrays[key] = np.array(data, copy=True) if async_save \
+                    else data
                 entry["chunks"].append({"offset": list(offset),
                                         "shape": list(cshape),
                                         "file": shard_file, "key": key})
@@ -128,12 +184,10 @@ def save_state_dict(state_dict, path, process_group=None,
                 v, np.generic) else v.item()}
 
     def _write():
-        import re
-        # stage to tmp names, then clean stale artifacts, then rename into
-        # place — the previous checkpoint stays valid until the new data is
-        # fully on disk (an interrupted async save can't destroy both)
+        # stage to tmp names, then rename into place: versioned filenames
+        # mean nothing from an older save id is ever touched
         shard_tmp = os.path.join(path, shard_file + ".tmp")
-        meta_name = f"{rank}.metadata.json"
+        meta_name = f"{rank}.{sid}.metadata.json"
         meta_tmp = os.path.join(path, meta_name + ".tmp")
         with open(shard_tmp, "wb") as f:
             np.savez(f, **arrays)
@@ -145,20 +199,8 @@ def save_state_dict(state_dict, path, process_group=None,
             os.fsync(f.fileno())
         os.replace(shard_tmp, os.path.join(path, shard_file))
         os.replace(meta_tmp, os.path.join(path, meta_name))
-        # only AFTER the new files are in place, remove stale artifacts so
-        # a re-save into an existing dir can't mix shards from a previous
-        # (possibly larger-world) checkpoint — and an interrupted save
-        # never leaves the directory with neither checkpoint complete
-        for fname in os.listdir(path):
-            m = re.match(r"^(\d+)(\.metadata\.json|_0\.distcp\.npz)$", fname)
-            owner = int(m.group(1)) if m else None
-            stale = (fname == "metadata.json"  # pre-chunk legacy layout
-                     or (owner is not None and rank == 0 and owner >= world))
-            if stale:
-                try:
-                    os.remove(os.path.join(path, fname))
-                except OSError:
-                    pass
+        if rank == coordinator_rank:
+            _gc_old_saves(path, sid, world)
 
     if async_save:
         def _guarded():
@@ -173,30 +215,64 @@ def save_state_dict(state_dict, path, process_group=None,
         _write()
 
 
-def _read_metadata(path):
+def _gc_old_saves(path, sid, world):
+    """Delete files from saves older than `sid` — but ONLY once save `sid`
+    is verifiably complete (all `world` metadata files present on shared
+    storage).  If other ranks are still writing, skip; a later save or load
+    retries.  This is the barrier-free version of
+    "no stale deletion before all ranks committed"."""
     import re
-    merged = {}
-    files = sorted(f for f in os.listdir(path)
-                   if re.match(r"^\d+\.metadata\.json$", f))
-    if not files:
+    present = sum(1 for f in os.listdir(path)
+                  if re.match(rf"^\d+\.{sid}\.metadata\.json$", f))
+    if present < world:
+        return
+    for fname in os.listdir(path):
+        m = re.match(r"^\d+(?:_0)?\.(\d+)\.(?:metadata\.json|distcp\.npz)$",
+                     fname)
+        legacy = (re.match(r"^\d+(?:_0)?\.(?:metadata\.json|distcp\.npz)$",
+                           fname) or fname == "metadata.json")
+        if legacy or (m and int(m.group(1)) < sid):
+            try:
+                os.remove(os.path.join(path, fname))
+            except OSError:
+                pass
+
+
+def _read_metadata(path):
+    """Merge the metadata of the NEWEST save id whose metadata set is
+    complete (file count == recorded world_size); incomplete/interrupted
+    saves are skipped so the previous checkpoint loads instead."""
+    import re
+    by_sid = {}
+    for fname in os.listdir(path):
+        m = re.match(_META_RE, fname)
+        if m:
+            by_sid.setdefault(int(m.group(2)), []).append(fname)
+        elif re.match(_LEGACY_META_RE, fname):
+            by_sid.setdefault(-1, []).append(fname)  # pre-versioning layout
+    if not by_sid:
         raise FileNotFoundError(f"no checkpoint metadata under {path}")
-    worlds = set()
-    for fname in files:
-        with open(os.path.join(path, fname)) as f:
-            meta = json.load(f)
-        if "world_size" in meta:
-            worlds.add(meta["world_size"])
-        for k, entry in meta["tensors"].items():
-            if k not in merged:
-                merged[k] = entry
-            elif "chunks" in entry:
-                merged[k]["chunks"].extend(entry["chunks"])
-    if len(worlds) > 1 or (worlds and len(files) != next(iter(worlds))):
-        raise RuntimeError(
-            f"checkpoint at {path} has {len(files)} metadata files but "
-            f"records world_size(s) {sorted(worlds)} — incomplete or "
-            f"stale-mixed checkpoint")
-    return merged
+    incomplete = []
+    for sid in sorted(by_sid, reverse=True):
+        files = sorted(by_sid[sid])
+        merged = {}
+        worlds = set()
+        for fname in files:
+            with open(os.path.join(path, fname)) as f:
+                meta = json.load(f)
+            if "world_size" in meta:
+                worlds.add(meta["world_size"])
+            for k, entry in meta["tensors"].items():
+                if k not in merged:
+                    merged[k] = entry
+                elif "chunks" in entry:
+                    merged[k]["chunks"].extend(entry["chunks"])
+        if len(worlds) == 1 and len(files) == next(iter(worlds)):
+            return merged
+        incomplete.append((sid, len(files), sorted(worlds)))
+    raise RuntimeError(
+        f"checkpoint at {path} has no complete save: per-save "
+        f"(save_id, metadata_files, recorded_world_sizes) = {incomplete}")
 
 
 class _ChunkReader:

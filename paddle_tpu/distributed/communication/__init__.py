@@ -128,20 +128,14 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
                        check_rep=False)
         tensor._data = sm(tensor._data)
         return _Task(tensor._data)
-    # multihost replicated eager allreduce over processes
-    try:
-        from jax.experimental import multihost_utils
-        summed = multihost_utils.process_allgather(tensor._data)
-        if op == ReduceOp.SUM:
-            tensor._data = jnp.sum(summed, axis=0)
-        elif op == ReduceOp.AVG:
-            tensor._data = jnp.mean(summed, axis=0)
-        elif op == ReduceOp.MAX:
-            tensor._data = jnp.max(summed, axis=0)
-        elif op == ReduceOp.MIN:
-            tensor._data = jnp.min(summed, axis=0)
-    except Exception:
-        pass
+    # multihost replicated eager allreduce over the group members
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(tensor._data)
+    ranks, gr = _group_members(group)
+    if gr < 0:
+        return _Task(tensor._data)
+    members = jnp.asarray(gathered)[jnp.asarray(ranks)]
+    tensor._data = _reduce_stacked(members, op)
     return _Task(tensor._data)
 
 
@@ -176,38 +170,131 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     return _Task(tensor._data)
 
 
+def _reduce_stacked(stacked, op):
+    if op == ReduceOp.SUM:
+        return jnp.sum(stacked, axis=0)
+    if op == ReduceOp.AVG:
+        return jnp.mean(stacked, axis=0)
+    if op == ReduceOp.MAX:
+        return jnp.max(stacked, axis=0)
+    if op == ReduceOp.MIN:
+        return jnp.min(stacked, axis=0)
+    if op == ReduceOp.PROD:
+        return jnp.prod(stacked, axis=0)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return all_reduce(tensor, op, group, sync_op)
+    """Reduce to ONE rank over the GROUP members: the result is defined only
+    at `dst`; every other rank's tensor is left unchanged (reference
+    semantics, communication/reduce.py — previously this wrongly aliased
+    all_reduce, placing an all-ranks reduction on every rank)."""
+    n = _nranks(group)
+    if n <= 1:
+        return _Task(tensor._data)
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(tensor._data)
+    ranks, gr = _group_members(group)
+    if gr < 0:
+        return _Task(tensor._data)
+    members = jnp.asarray(gathered)[jnp.asarray(ranks)]
+    if jax.process_index() == dst:
+        tensor._data = _reduce_stacked(members, op)
+    return _Task(tensor._data)
+
+
+def _group_members(group):
+    """(ranks, my_group_rank).  Eager subgroup collectives are built on
+    multihost_utils primitives, which are collective over ALL processes —
+    so every process (member or not) must call; non-members contribute
+    zeros and keep their tensor unchanged."""
+    n_world = get_world_size()
+    ranks = (list(group.ranks) if group is not None and group.ranks
+             else list(range(n_world)))
+    me = jax.process_index()
+    return ranks, (ranks.index(me) if me in ranks else -1)
 
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
+    """Each group member contributes `nranks` chunks; member r receives the
+    reduction of every member's chunk r (reference:
+    communication/reduce_scatter.py).  Eager path: host-level allgather +
+    local reduction — correct on single- and multi-host; compiled code
+    should rely on GSPMD's reduce-scatter."""
     n = _nranks(group)
+    if isinstance(tensor_list, (list, tuple)):
+        srcs = [s._data for s in tensor_list]
+    else:
+        # single-tensor form: the input is the concatenation of the n
+        # chunks along dim 0 (reference stream/reduce_scatter.py)
+        srcs = (list(jnp.split(tensor_list._data, n, axis=0)) if n > 1
+                else [tensor_list._data])
     if n <= 1:
-        src = tensor_list[0] if isinstance(tensor_list, (list, tuple)) \
-            else tensor_list
-        tensor._data = src._data
+        tensor._data = srcs[0]
         return _Task(tensor._data)
-    raise NotImplementedError("eager multi-host reduce_scatter: use the "
-                              "compiled path (GSPMD inserts reduce-scatter)")
+    if len(srcs) != n:
+        raise ValueError(
+            f"reduce_scatter needs exactly nranks={n} input chunks, got "
+            f"{len(srcs)}")
+    from jax.experimental import multihost_utils
+    stacked = jnp.stack(srcs)                              # [n, ...]
+    gathered = multihost_utils.process_allgather(stacked)  # [world, n, ...]
+    ranks, gr = _group_members(group)
+    if gr < 0:
+        return _Task(tensor._data)
+    members = jnp.asarray(gathered)[jnp.asarray(ranks)]    # [n, n, ...]
+    red = _reduce_stacked(members, op)                     # [n, ...]
+    tensor._data = jnp.asarray(red[gr])
+    return _Task(tensor._data)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Global rank `src` distributes one chunk to each group member
+    (reference: communication/scatter.py)."""
     n = _nranks(group)
     if n <= 1:
         if tensor_list:
             tensor._data = tensor_list[0]._data
         return _Task(tensor._data)
-    raise NotImplementedError
+    from jax.experimental import multihost_utils
+    me = jax.process_index()
+    if me == src and not tensor_list:
+        raise ValueError(
+            "scatter: the source rank must provide tensor_list (one chunk "
+            "per group member)")
+    if tensor_list:
+        stacked = jnp.stack([t._data for t in tensor_list])
+    else:
+        # non-source ranks may omit tensor_list; shape must still match
+        stacked = jnp.zeros((n,) + tuple(tensor._data.shape),
+                            tensor._data.dtype)
+    data = multihost_utils.broadcast_one_to_all(stacked,
+                                                is_source=(me == src))
+    ranks, gr = _group_members(group)
+    if gr < 0:
+        return _Task(tensor._data)
+    tensor._data = jnp.asarray(data[gr])
+    return _Task(tensor._data)
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """out[i] on member r = in[r] on member i (reference:
+    communication/all_to_all.py)."""
     n = _nranks(group)
     if n <= 1:
         out_tensor_list.extend(Tensor._wrap(t._data) for t in in_tensor_list)
         return _Task(None)
-    raise NotImplementedError("eager multi-host all_to_all: use the compiled "
-                              "path (lax.all_to_all under shard_map)")
+    from jax.experimental import multihost_utils
+    stacked = jnp.stack([t._data for t in in_tensor_list])  # [n, ...]
+    gathered = multihost_utils.process_allgather(stacked)   # [world, n, ...]
+    ranks, gr = _group_members(group)
+    if gr < 0:
+        return _Task(None)
+    members = jnp.asarray(gathered)[jnp.asarray(ranks)]     # [n, n, ...]
+    out_tensor_list.extend(Tensor._wrap(jnp.asarray(members[i][gr]))
+                           for i in range(n))
+    return _Task(None)
 
 
 def send(tensor, dst=0, group=None, sync_op=True):

@@ -52,6 +52,11 @@ def init_parallel_env():
     processes; single-process runs are a no-op."""
     if int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1 or \
             os.environ.get("COORDINATOR_ADDRESS"):
+        # A failed bootstrap must be fatal: swallowing it would silently turn
+        # an N-process job into N independent single-process runs (each
+        # training on its own shard with no gradient sync — wrong results,
+        # not a crash).  Reference: init_parallel_env raises on store/comm
+        # init failure too (distributed/parallel.py:945).
         try:
             jax.distributed.initialize(
                 coordinator_address=os.environ.get(
@@ -59,8 +64,18 @@ def init_parallel_env():
                     os.environ.get("PADDLE_MASTER", None)),
                 num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
                 process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
-        except Exception:
-            pass
+        except RuntimeError as e:
+            if "already initialized" in str(e).lower():
+                pass  # idempotent re-init (e.g. fleet.init after launcher)
+            else:
+                raise RuntimeError(
+                    "jax.distributed.initialize failed for "
+                    f"coordinator={os.environ.get('COORDINATOR_ADDRESS') or os.environ.get('PADDLE_MASTER')!r} "
+                    f"num_processes={os.environ.get('PADDLE_TRAINERS_NUM')} "
+                    f"process_id={os.environ.get('PADDLE_TRAINER_ID')}; "
+                    "refusing to continue as a single-process run. Check the "
+                    "coordinator address is reachable and the PADDLE_TRAINER_* "
+                    "env vars set by the launcher.") from e
     _INITIALIZED[0] = True
     return ParallelEnv()
 

@@ -57,8 +57,15 @@ def topk_gating(gates, top_k, capacity):
 
     dispatch = jnp.zeros((T, E, capacity), gates.dtype)
     combine = jnp.zeros((T, E, capacity), gates.dtype)
-    # normalise the selected gate values over the k choices
-    wsum = sum((gates * m).sum(-1) for m in masks)
+    # Normalise the selected gate values over the k choices — except for
+    # top-1 (switch), where the normalised weight would be identically 1.0
+    # with zero gradient to the router; Switch Transformer scales the expert
+    # output by the RAW top-1 probability, which is the router's primary
+    # task-loss learning signal (reference: moe/gate/switch_gate.py).
+    if top_k == 1:
+        wsum = jnp.ones((T,), gates.dtype)
+    else:
+        wsum = sum((gates * m).sum(-1) for m in masks)
     offset = jnp.zeros((E,), jnp.int32)
     for m in masks:
         mi = m.astype(jnp.int32)

@@ -55,6 +55,25 @@ def _round_to_divisor(block, s):
     return block
 
 
+def _env_block(name, default):
+    """Read a block-size override env var; fail loudly on junk values."""
+    import os
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not an integer; set it to a multiple of 128"
+            " (e.g. 512) or unset it") from None
+    if val < 128 or val % 128:
+        raise ValueError(
+            f"{name}={val} must be a multiple of 128 and >= 128 (TPU lane"
+            " alignment)")
+    return val
+
+
 def _pick_blocks(h, s, d, itemsize):
     """(bh, block_q, block_k): heads per program + q/k tile sizes.
 
@@ -63,9 +82,8 @@ def _pick_blocks(h, s, d, itemsize):
     arrays (q, do) plus k/v tiles per head group; `itemsize` is the input
     dtype width (fp32 attention is supported and doubles the footprint).
     """
-    import os
-    block_q = _round_to_divisor(int(os.environ.get("PTPU_FA_BQ", 1024)), s)
-    block_k = _round_to_divisor(int(os.environ.get("PTPU_FA_BK", 512)), s)
+    block_q = _round_to_divisor(_env_block("PTPU_FA_BQ", 1024), s)
+    block_k = _round_to_divisor(_env_block("PTPU_FA_BK", 512), s)
     bh = 1
     for cand in (8, 4, 2):
         if h % cand == 0 and cand * (2 * s * d * itemsize) <= 6 * 1024 * 1024:
@@ -257,7 +275,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[0, hh] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, causal, scale):
+def _flash_bwd(q, k, v, out, lse, do, causal, scale, dlse=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -265,6 +283,11 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale):
     bh, block_q, block_k = _pick_blocks(h, s, d, q.dtype.itemsize)
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [b, h, s, 1] — lane-aligned like lse
+    if dlse is not None:
+        # A cotangent g on lse enters as ds_ij += g_i * p_ij (because
+        # d lse_i / d s_ij = p_ij); the kernels compute ds = p*(dp - delta),
+        # so folding it in as delta' = delta - g gives p*(dp - delta + g).
+        delta = delta - dlse.astype(jnp.float32)[..., None]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -343,6 +366,36 @@ def _flash_vjp_bwd(causal, scale, res, do):
 _flash_attention_bhsd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_with_lse(q, k, v, causal, scale):
+    """(out, lse) flash attention, [B, H, S, D] layout, differentiable.
+
+    lse is [B, H, S] fp32.  Used by ring attention (kernels/ring_attention.py)
+    whose online-softmax merge needs the per-chunk LSE *and* gradients through
+    both outputs — the lse cotangent folds into the flash backward via the
+    delta term (see _flash_bwd)."""
+    out, lse = _flash_fwd(q, k, v, causal, scale)
+    return out, lse[..., 0]
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, scale):
+    out, lse = _flash_fwd(q, k, v, causal, scale)
+    return (out, lse[..., 0]), (q, k, v, out, lse)
+
+
+def _flash_lse_vjp_bwd(causal, scale, res, cot):
+    do, dlse = cot
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, causal, scale, dlse=dlse)
+    return dq, dk, dv
+
+
+flash_attention_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+_warned_fallback = [False]
+
+
 def flash_attention_fwd(q, k, v, causal=False, scale=None):
     """Public entry, [B, S, H, D] layout; differentiable (custom VJP)."""
     if scale is None:
@@ -351,6 +404,14 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None):
         return reference_attention(q, k, v, causal, scale)
     s = q.shape[1]
     if s % 128 != 0:
+        if _on_tpu() and not _warned_fallback[0]:
+            _warned_fallback[0] = True
+            import warnings
+            warnings.warn(
+                f"flash_attention: seq_len={s} is not a multiple of 128;"
+                " falling back to O(S^2) reference attention on TPU. Pad the"
+                " sequence to a 128 multiple for the Pallas kernel.",
+                RuntimeWarning, stacklevel=2)
         return reference_attention(q, k, v, causal, scale)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)

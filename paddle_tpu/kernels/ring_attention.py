@@ -40,13 +40,12 @@ def _chunk_attention(q, k, v, causal, scale):
     lse is [B, S, H] (fp32)."""
     if (_on_tpu() or _INTERPRET[0]) and q.shape[1] % 128 == 0 \
             and k.shape[1] % 128 == 0:
-        from .flash_attention import _flash_fwd
+        from .flash_attention import flash_attention_with_lse
         qt = jnp.swapaxes(q, 1, 2)
         kt = jnp.swapaxes(k, 1, 2)
         vt = jnp.swapaxes(v, 1, 2)
-        out, lse = _flash_fwd(qt, kt, vt, causal, scale)
-        return (jnp.swapaxes(out, 1, 2),
-                jnp.swapaxes(lse[..., 0], 1, 2))
+        out, lse = flash_attention_with_lse(qt, kt, vt, causal, scale)
+        return jnp.swapaxes(out, 1, 2), jnp.swapaxes(lse, 1, 2)
     # jnp fallback (CPU tests / odd chunk sizes)
     logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32) * scale,
                         k.astype(jnp.float32))

@@ -279,17 +279,27 @@ class GPTForCausalLM(Layer):
                         f"divisible by pp*virtual_pp ({n_stage})")
                 lpp = L // n_stage
 
+                moe = c.num_experts > 0
+
                 def stage_fn(sp, hh):
-                    # MoE aux loss is dropped on the pipeline path (the
-                    # stage contract carries activations only); use
-                    # moe_aux_loss() with pp=1 meshes
-                    def body(hh, lw):
-                        hh, _aux = block(hh, (lw, dkey))
-                        return hh, None
-                    hh, _ = jax.lax.scan(body, hh, sp)
-                    return hh
+                    # aux (MoE load-balancing loss) rides the pipeline via
+                    # pipeline_apply(with_aux=True) instead of being dropped;
+                    # per-layer dropout keys travel in sp ('__keys') so each
+                    # layer gets an independent mask (matching the pp=1 scan)
+                    def body(carry, xs):
+                        hh, aux_sum = carry
+                        lw = {k: v for k, v in xs.items() if k != "__keys"}
+                        key = xs["__keys"] if dkey is not None else None
+                        hh, aux = block(hh, (lw, key))
+                        if aux is not None:
+                            aux_sum = aux_sum + aux
+                        return (hh, aux_sum), None
+                    (hh, aux), _ = jax.lax.scan(
+                        body, (hh, jnp.zeros((), jnp.float32)), sp)
+                    return (hh, aux) if moe else hh
                 stage_params = {n: v.reshape(n_stage, lpp, *v.shape[1:])
                                 for n, v in lws.items()}
+                stage_params["__keys"] = keys.reshape(n_stage, lpp, 2)
                 M = max(2 * pp, 1)
                 # microbatches must divide batch
                 while ids.shape[0] % M != 0 and M > 1:
@@ -310,7 +320,10 @@ class GPTForCausalLM(Layer):
                                    if c.pp_schedule == "interleaved"
                                    else "gpipe",
                                    num_chunks=max(V, 1),
-                                   remat_policy=sel_policy)
+                                   remat_policy=sel_policy,
+                                   with_aux=moe)
+                if moe:
+                    h, aux_pp = h
             else:
                 def body(hh, xs):
                     lw, key = xs
@@ -341,8 +354,8 @@ class GPTForCausalLM(Layer):
                 logits = jax.lax.with_sharding_constraint(
                     logits, jax.sharding.NamedSharding(
                         mesh, P(("dp", "sharding"), None, "mp")))
-            if c.num_experts > 0 and pp <= 1:
-                return logits, jnp.sum(auxs)
+            if c.num_experts > 0:
+                return logits, (aux_pp if pp > 1 else jnp.sum(auxs))
             return logits
 
         args = [input_ids, self.wte, self.lnf_w, self.lnf_b]
@@ -364,9 +377,10 @@ class GPTForCausalLM(Layer):
 
     def moe_aux_loss(self):
         """Summed MoE load-balancing loss from the last forward (0 when the
-        model is dense or the pipeline path dropped it).  Add
-        `model.moe_aux_loss() * coeff` to the training loss (reference
-        trainers do the same with the gate loss)."""
+        model is dense).  Carried through the pipeline schedules via
+        pipeline_apply(with_aux=True).  Add `model.moe_aux_loss() * coeff`
+        to the training loss (reference trainers do the same with the gate
+        loss, moe/moe_layer.py)."""
         if getattr(self, "_moe_aux", None) is None:
             return Tensor._wrap(jnp.zeros((), jnp.float32))
         return self._moe_aux

@@ -83,6 +83,40 @@ class TestShardedCheckpoint:
         with pytest.raises(ValueError):
             paddle.distributed.load_state_dict({"t": bad}, str(tmp_path))
 
+    def test_interrupted_resave_keeps_previous_loadable(self, tmp_path):
+        """A crash mid-save (here: a stale incomplete higher save id, as a
+        shrunk-world crash would leave) must not corrupt the previous
+        checkpoint — load falls back to the newest COMPLETE save id.
+        Regression for the round-4 advisor finding that rank 0 deleted
+        old-world files with no all-ranks-committed barrier."""
+        t = paddle.randn([4, 4])
+        t_np = np_t(t).copy()
+        paddle.distributed.save_state_dict({"t": t}, str(tmp_path))
+        # simulate an interrupted save: metadata for sid=5 claims world 2
+        # but only one rank's file made it to disk before the crash
+        with open(os.path.join(tmp_path, "0.5.metadata.json"), "w") as f:
+            json.dump({"world_size": 2, "save_id": 5,
+                       "tensors": {"t": {"shape": [4, 4],
+                                         "dtype": "float32",
+                                         "chunks": []}}}, f)
+        t2 = paddle.zeros([4, 4])
+        paddle.distributed.load_state_dict({"t": t2}, str(tmp_path))
+        assert np.allclose(np_t(t2), t_np)
+
+    def test_resave_gc_and_newest_wins(self, tmp_path):
+        """Repeated saves to one dir: each save gets a fresh id, load picks
+        the newest, and completed older saves are garbage-collected."""
+        t = paddle.randn([4, 4])
+        paddle.distributed.save_state_dict({"t": t}, str(tmp_path))
+        t = paddle.ones([4, 4]) * 3.0
+        paddle.distributed.save_state_dict({"t": t}, str(tmp_path))
+        t2 = paddle.zeros([4, 4])
+        paddle.distributed.load_state_dict({"t": t2}, str(tmp_path))
+        assert np.allclose(np_t(t2), 3.0)
+        metas = [f for f in os.listdir(tmp_path)
+                 if f.endswith("metadata.json")]
+        assert len(metas) == 1, metas  # older save GC'd
+
     def test_async_save(self, tmp_path):
         from paddle_tpu.distributed.checkpoint import wait_async_save
         t = paddle.randn([4, 4])

@@ -18,7 +18,10 @@ def naive_moe(x, gate_w, fc1_w, fc1_b, fc2_w, fc2_b, top_k):
     for t in range(T):
         order = np.argsort(-gates[t])[:top_k]
         w = gates[t][order]
-        w = w / w.sum()
+        if top_k > 1:
+            w = w / w.sum()
+        # top-1 keeps the RAW probability (Switch Transformer semantics —
+        # the normalised weight would be identically 1 with no router grad)
         for e, wi in zip(order, w):
             hdn = np.maximum(x[t] @ fc1_w[e] + fc1_b[e], 0.0)  # relu
             y[t] += wi * (hdn @ fc2_w[e] + fc2_b[e])
@@ -51,6 +54,28 @@ class TestMoeDispatch:
         assert np.allclose(np.asarray(y), ref, atol=1e-4), \
             np.abs(np.asarray(y) - ref).max()
         assert float(aux) > 0
+
+    def test_top1_router_gets_task_gradient(self):
+        """Regression (round-4 advisor): with top_k=1 the combine weight was
+        normalised to identically 1.0, so d(task_loss)/d(gate_w) was zero and
+        the switch router could only learn from the aux loss.  The raw-prob
+        combine weight must carry a nonzero task gradient."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.moe import moe_ffn
+
+        x, gw, w1, b1, w2, b2 = self._mk(seed=3)
+
+        def task_loss(gw):
+            y, _aux = moe_ffn(jnp.asarray(x), gw, jnp.asarray(w1),
+                              jnp.asarray(b1), jnp.asarray(w2),
+                              jnp.asarray(b2), top_k=1, capacity_factor=4.0,
+                              activation=jax.nn.relu)
+            return jnp.sum(y ** 2)
+
+        g = jax.grad(task_loss)(jnp.asarray(gw))
+        assert float(jnp.abs(g).max()) > 1e-6, \
+            "switch router receives no task-loss gradient"
 
     def test_compute_scales_with_top_k_not_E(self):
         """Expert tensors are [E, C, .] with E*C ~= k*T*cf — NOT [T, E, .]:
