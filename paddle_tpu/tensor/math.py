@@ -270,6 +270,24 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return apply_op("cumprod", lambda v: jnp.cumprod(v, axis=int(dim)), _t(x))
 
 
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    """n-th forward difference along `axis` (reference: tensor/math.py diff)."""
+    x = _t(x)
+    pre = None if prepend is None else _t(prepend)._data
+    app = None if append is None else _t(append)._data
+
+    def fn(v, *extras):
+        it = iter(extras)
+        p = next(it) if pre is not None else None
+        a = next(it) if app is not None else None
+        return jnp.diff(v, n=n, axis=int(axis),
+                        **({"prepend": p} if p is not None else {}),
+                        **({"append": a} if a is not None else {}))
+
+    extras = [e for e in (pre, app) if e is not None]
+    return apply_op("diff", fn, x, *[Tensor._wrap(e) for e in extras])
+
+
 def cummax(x, axis=None, dtype="int64", name=None):
     x = _t(x)
     ax = -1 if axis is None else int(axis)

@@ -1,0 +1,281 @@
+"""Per-op parity suite over the OpTest-style harness (tests/op_harness.py).
+
+Reference: /root/reference/test/legacy_test/op_test.py + the per-op
+test_*_op.py files under test/legacy_test/ — each case here plays the role
+of one OpTest subclass: numpy reference vs eager vs jit vs dp-sharded,
+fp32/bf16/fp16, plus numeric-vs-analytic gradients.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_harness import OpCase, run_case
+
+rng = np.random.RandomState(0)
+
+
+def A(*shape):
+    return rng.uniform(-1.0, 1.0, size=shape).astype(np.float32)
+
+
+def POS(*shape):
+    return rng.uniform(0.1, 2.0, size=shape).astype(np.float32)
+
+
+X = A(8, 4)
+Y = A(8, 4)
+XP = POS(8, 4)
+M1 = A(8, 4)
+M2 = A(4, 8)
+V = A(8)
+IDX = rng.randint(0, 4, size=(8,)).astype(np.int64)
+LOGITS = A(8, 5)
+LABELS = rng.randint(0, 5, size=(8,)).astype(np.int64)
+
+
+def _sm(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+CASES = [
+    # ---- elementwise binary -------------------------------------------------
+    OpCase("add", paddle.add, lambda a, b: a + b, [X, Y]),
+    OpCase("subtract", paddle.subtract, lambda a, b: a - b, [X, Y]),
+    OpCase("multiply", paddle.multiply, lambda a, b: a * b, [X, Y]),
+    OpCase("divide", paddle.divide, lambda a, b: a / b, [X, XP]),
+    OpCase("pow", paddle.pow, lambda a, b: a ** b, [XP, Y]),
+    OpCase("maximum", paddle.maximum, np.maximum, [X, Y]),
+    OpCase("minimum", paddle.minimum, np.minimum, [X, Y]),
+    OpCase("fmax", paddle.fmax, np.fmax, [X, Y]),
+    OpCase("fmin", paddle.fmin, np.fmin, [X, Y]),
+    OpCase("atan2", paddle.atan2, np.arctan2, [X, XP]),
+    OpCase("lerp", paddle.lerp, lambda a, b, w: a + w * (b - a),
+           [X, Y, POS(8, 4)]),
+    # ---- elementwise unary --------------------------------------------------
+    OpCase("exp", paddle.exp, np.exp, [X]),
+    OpCase("expm1", paddle.expm1, np.expm1, [X]),
+    OpCase("log", paddle.log, np.log, [XP]),
+    OpCase("log1p", paddle.log1p, np.log1p, [XP]),
+    OpCase("log2", paddle.log2, np.log2, [XP]),
+    OpCase("sqrt", paddle.sqrt, np.sqrt, [XP]),
+    OpCase("rsqrt", paddle.rsqrt, lambda x: 1 / np.sqrt(x), [XP]),
+    OpCase("abs", paddle.abs, np.abs, [X]),
+    OpCase("neg", paddle.neg, np.negative, [X]),
+    OpCase("sin", paddle.sin, np.sin, [X]),
+    OpCase("cos", paddle.cos, np.cos, [X]),
+    OpCase("tan", paddle.tan, np.tan, [X]),
+    OpCase("asin", paddle.asin, np.arcsin, [X]),
+    OpCase("atan", paddle.atan, np.arctan, [X]),
+    OpCase("sinh", paddle.sinh, np.sinh, [X]),
+    OpCase("cosh", paddle.cosh, np.cosh, [X]),
+    OpCase("tanh", paddle.tanh, np.tanh, [X]),
+    OpCase("erf", paddle.erf, lambda x: np.vectorize(__import__(
+        "math").erf)(x).astype(np.float32), [X]),
+    OpCase("floor", paddle.floor, np.floor, [X], grad=False),
+    OpCase("ceil", paddle.ceil, np.ceil, [X], grad=False),
+    OpCase("round", paddle.round, np.round, [X], grad=False),
+    OpCase("trunc", paddle.trunc, np.trunc, [X], grad=False),
+    OpCase("sign", paddle.sign, np.sign, [X], grad=False),
+    OpCase("reciprocal", paddle.reciprocal, lambda x: 1.0 / x, [XP]),
+    OpCase("square", paddle.square, np.square, [X]),
+    OpCase("logit", paddle.logit,
+           lambda x: np.log(x / (1 - x)), [POS(8, 4) * 0.4],
+           tol={"bfloat16": (5e-2, 5e-2)}),
+    # ---- activations --------------------------------------------------------
+    OpCase("relu", F.relu, lambda x: np.maximum(x, 0), [X]),
+    OpCase("sigmoid", F.sigmoid, lambda x: 1 / (1 + np.exp(-x)), [X]),
+    OpCase("gelu", F.gelu,
+           lambda x: x * 0.5 * (1 + np.vectorize(__import__("math").erf)(
+               x / np.sqrt(2)).astype(np.float32)), [X]),
+    OpCase("silu", F.silu, lambda x: x / (1 + np.exp(-x)), [X]),
+    OpCase("softplus", F.softplus, lambda x: np.log1p(np.exp(x)), [X]),
+    OpCase("elu", F.elu, lambda x: np.where(x > 0, x, np.exp(x) - 1), [X]),
+    OpCase("leaky_relu", F.leaky_relu,
+           lambda x: np.where(x > 0, x, 0.01 * x), [X]),
+    OpCase("hardswish", F.hardswish,
+           lambda x: x * np.clip(x + 3, 0, 6) / 6, [X],
+           max_relative_error=0.1),
+    OpCase("mish", F.mish, lambda x: x * np.tanh(np.log1p(np.exp(x))), [X]),
+    OpCase("softmax", F.softmax, _sm, [X]),
+    OpCase("log_softmax", F.log_softmax,
+           lambda x: np.log(_sm(x)), [X]),
+    # ---- reductions ---------------------------------------------------------
+    OpCase("sum", paddle.sum, np.sum, [X]),
+    OpCase("sum_axis", lambda t: paddle.sum(t, axis=1),
+           lambda x: x.sum(1), [X]),
+    OpCase("mean", paddle.mean, np.mean, [X]),
+    OpCase("max", paddle.max, np.max, [X]),
+    OpCase("min", paddle.min, np.min, [X]),
+    OpCase("prod", paddle.prod, np.prod, [A(2, 3) * 0.5 + 1.0],
+           sharded=False),
+    OpCase("logsumexp", paddle.logsumexp,
+           lambda x: np.log(np.exp(x).sum()), [X]),
+    OpCase("argmax", lambda t: paddle.argmax(t, axis=1),
+           lambda x: x.argmax(1), [X], grad=False, dtypes=("float32",)),
+    OpCase("argmin", lambda t: paddle.argmin(t, axis=1),
+           lambda x: x.argmin(1), [X], grad=False, dtypes=("float32",)),
+    OpCase("cumsum", lambda t: paddle.cumsum(t, axis=1),
+           lambda x: x.cumsum(1), [X]),
+    OpCase("cumprod", lambda t: paddle.cumprod(t, dim=1),
+           lambda x: np.cumprod(x, 1), [XP]),
+    OpCase("std", paddle.std, lambda x: x.std(ddof=1), [X],
+           max_relative_error=0.08),
+    OpCase("var", paddle.var, lambda x: x.var(ddof=1), [X]),
+    OpCase("median", paddle.median, np.median, [A(8, 5)], grad=False,
+           dtypes=("float32",)),
+    OpCase("nanmean", paddle.nanmean, np.nanmean, [X], grad=False),
+    # ---- linear algebra -----------------------------------------------------
+    OpCase("matmul", paddle.matmul, lambda a, b: a @ b, [M1, M2],
+           tol={"bfloat16": (3e-2, 3e-2), "float16": (4e-3, 4e-3)}),
+    OpCase("bmm", paddle.bmm, lambda a, b: a @ b,
+           [A(8, 3, 4), A(8, 4, 5)],
+           tol={"bfloat16": (3e-2, 3e-2), "float16": (4e-3, 4e-3)}),
+    OpCase("dot", paddle.dot, lambda a, b: (a * b).sum(-1), [V, A(8)]),
+    OpCase("t", paddle.t, np.transpose, [M1], sharded=False),
+    OpCase("norm_fro", lambda t: paddle.linalg.norm(t),
+           lambda x: np.linalg.norm(x), [X]),
+    OpCase("outer", paddle.outer, np.outer, [V, A(4)], sharded=False),
+    OpCase("diag", paddle.diag, np.diag, [V], sharded=False),
+    OpCase("tril", paddle.tril, np.tril, [M1]),
+    OpCase("triu", paddle.triu, np.triu, [M1]),
+    OpCase("kron", paddle.kron, np.kron, [A(2, 3), A(3, 2)],
+           sharded=False),
+    # ---- manipulation -------------------------------------------------------
+    OpCase("reshape", lambda t: paddle.reshape(t, [4, 8]),
+           lambda x: x.reshape(4, 8), [X], sharded=False),
+    OpCase("transpose", lambda t: paddle.transpose(t, [1, 0]),
+           lambda x: x.T, [X], sharded=False),
+    OpCase("concat", lambda a, b: paddle.concat([a, b], axis=1),
+           lambda a, b: np.concatenate([a, b], 1), [X, Y]),
+    OpCase("stack", lambda a, b: paddle.stack([a, b], axis=0),
+           lambda a, b: np.stack([a, b]), [X, Y], sharded=False),
+    OpCase("split", lambda t: paddle.split(t, 2, axis=1),
+           lambda x: np.split(x, 2, 1), [X]),
+    OpCase("squeeze", lambda t: paddle.squeeze(t, axis=1),
+           lambda x: x.squeeze(1), [A(8, 1, 4)]),
+    OpCase("unsqueeze", lambda t: paddle.unsqueeze(t, axis=1),
+           lambda x: x[:, None], [X]),
+    OpCase("flatten", lambda t: paddle.flatten(t, start_axis=1),
+           lambda x: x.reshape(8, -1), [A(8, 2, 2)]),
+    OpCase("tile", lambda t: paddle.tile(t, [2, 3]),
+           lambda x: np.tile(x, (2, 3)), [X], sharded=False),
+    OpCase("expand", lambda t: paddle.expand(t, [8, 4]),
+           lambda x: np.broadcast_to(x, (8, 4)).copy(), [A(1, 4)],
+           sharded=False),
+    OpCase("roll", lambda t: paddle.roll(t, 2, axis=0),
+           lambda x: np.roll(x, 2, 0), [X], sharded=False),
+    OpCase("flip", lambda t: paddle.flip(t, axis=[0]),
+           lambda x: x[::-1].copy(), [X], sharded=False),
+    OpCase("clip", lambda t: paddle.clip(t, -0.5, 0.5),
+           lambda x: np.clip(x, -0.5, 0.5), [X]),
+    OpCase("gather", lambda t, i: paddle.gather(t, i, axis=0),
+           lambda x, i: x[i], [X, IDX], integer_inputs=(1,)),
+    OpCase("index_select", lambda t, i: paddle.index_select(t, i, axis=0),
+           lambda x, i: x[i], [X, IDX], integer_inputs=(1,)),
+    OpCase("where", paddle.where,
+           lambda c, a, b: np.where(c, a, b),
+           [X > 0, X, Y], integer_inputs=(0,)),
+    OpCase("masked_select", paddle.masked_select,
+           lambda x, m: x[m], [X, X > 0], integer_inputs=(1,),
+           sharded=False, grad=False, jit=False),  # data-dependent shape
+    OpCase("pad", lambda t: F.pad(t, [1, 1, 2, 2]),
+           lambda x: np.pad(x, ((1, 1), (2, 2))), [X], sharded=False),
+    OpCase("chunk", lambda t: paddle.chunk(t, 2, axis=0),
+           lambda x: np.split(x, 2, 0), [X], sharded=False),
+    OpCase("one_hot", lambda i: F.one_hot(i, num_classes=4),
+           lambda i: np.eye(4, dtype=np.float32)[i],
+           [IDX], integer_inputs=(0,), grad=False, dtypes=("float32",)),
+    # ---- indexing / search --------------------------------------------------
+    OpCase("topk", lambda t: paddle.topk(t, k=2, axis=1),
+           lambda x: (np.sort(x, 1)[:, ::-1][:, :2].copy(),
+                      np.argsort(-x, 1, kind="stable")[:, :2].copy()),
+           [X], grad=False, dtypes=("float32",)),
+    OpCase("sort", lambda t: paddle.sort(t, axis=1),
+           lambda x: np.sort(x, 1), [X], grad=False),
+    OpCase("argsort", lambda t: paddle.argsort(t, axis=1),
+           lambda x: np.argsort(x, 1, kind="stable"), [X], grad=False,
+           dtypes=("float32",)),
+    OpCase("unique", paddle.unique, np.unique,
+           [rng.randint(0, 5, (12,)).astype(np.int64)],
+           integer_inputs=(0,), grad=False, sharded=False, jit=False,
+           dtypes=("float32",)),
+    # ---- comparison / logical ----------------------------------------------
+    OpCase("equal", paddle.equal, lambda a, b: a == b,
+           [IDX.astype(np.float32), IDX.astype(np.float32)], grad=False,
+           dtypes=("float32",)),
+    OpCase("greater_than", paddle.greater_than, lambda a, b: a > b,
+           [X, Y], grad=False, dtypes=("float32",)),
+    OpCase("less_equal", paddle.less_equal, lambda a, b: a <= b,
+           [X, Y], grad=False, dtypes=("float32",)),
+    OpCase("isnan", paddle.isnan, np.isnan,
+           [np.where(X > 0.8, np.nan, X).astype(np.float32)], grad=False,
+           dtypes=("float32",)),
+    OpCase("isfinite", paddle.isfinite, np.isfinite, [X], grad=False,
+           dtypes=("float32",)),
+    OpCase("logical_and", paddle.logical_and, np.logical_and,
+           [X > 0, Y > 0], integer_inputs=(0, 1), grad=False,
+           dtypes=("float32",)),
+    # ---- nn functional ------------------------------------------------------
+    OpCase("linear", F.linear,
+           lambda x, w, b: x @ w + b, [X, A(4, 6), A(6)],
+           tol={"bfloat16": (3e-2, 3e-2), "float16": (4e-3, 4e-3)}),
+    OpCase("embedding", lambda i, w: F.embedding(i, w),
+           lambda i, w: w[i], [IDX, A(4, 6)], integer_inputs=(0,)),
+    OpCase("layer_norm",
+           lambda x, w, b: F.layer_norm(x, (4,), weight=w, bias=b),
+           lambda x, w, b: ((x - x.mean(-1, keepdims=True)) /
+                            np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+                            * w + b),
+           [X, POS(4), A(4)], max_relative_error=0.08),
+    OpCase("mse_loss", F.mse_loss,
+           lambda a, b: ((a - b) ** 2).mean(), [X, Y]),
+    OpCase("l1_loss", F.l1_loss,
+           lambda a, b: np.abs(a - b).mean(), [X, Y]),
+    OpCase("cross_entropy",
+           lambda lo, la: F.cross_entropy(lo, la),
+           lambda lo, la: -np.log(_sm(lo)[np.arange(8), la]).mean(),
+           [LOGITS, LABELS], integer_inputs=(1,)),
+    OpCase("nll_loss",
+           lambda lo, la: F.nll_loss(lo, la),
+           lambda lo, la: -lo[np.arange(8), la].mean(),
+           [np.log(_sm(LOGITS)), LABELS], integer_inputs=(1,)),
+    OpCase("binary_cross_entropy", F.binary_cross_entropy,
+           lambda p, t: -(t * np.log(p) + (1 - t) * np.log(1 - p)).mean(),
+           [POS(8, 4) * 0.4, (A(8, 4) > 0).astype(np.float32)],
+           integer_inputs=(1,)),
+    OpCase("cosine_similarity", F.cosine_similarity,
+           lambda a, b: (a * b).sum(-1) /
+           (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1)),
+           [X, Y]),
+    # ---- misc ---------------------------------------------------------------
+    OpCase("allclose", paddle.allclose, np.allclose, [X, X], grad=False,
+           dtypes=("float32",), sharded=False),
+    OpCase("diff", paddle.diff, lambda x: np.diff(x), [V], sharded=False),
+    OpCase("histogram",
+           lambda t: paddle.histogram(t, bins=4, min=-1, max=1),
+           lambda x: np.histogram(x, bins=4, range=(-1, 1))[0],
+           [X], grad=False, dtypes=("float32",), sharded=False),
+    OpCase("bincount", paddle.bincount, np.bincount,
+           [rng.randint(0, 5, (12,)).astype(np.int64)],
+           integer_inputs=(0,), grad=False, sharded=False, jit=False,
+           dtypes=("float32",)),
+    OpCase("trace", paddle.trace, np.trace, [A(4, 4)], sharded=False),
+]
+
+_IDS = [c.name for c in CASES]
+assert len(set(_IDS)) == len(_IDS), "duplicate case names"
+
+
+@pytest.mark.parametrize("case", CASES, ids=_IDS)
+def test_op_parity(case):
+    run_case(case)
+
+
+def test_case_count_at_least_50():
+    """SURVEY §4 / round-5 verdict: >=50 highest-traffic ops through the
+    multi-path harness."""
+    assert len(CASES) >= 50, len(CASES)
