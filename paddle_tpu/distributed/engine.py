@@ -196,10 +196,13 @@ class Pipeline1F1BTrainStep(DistributedTrainStep):
     """
 
     def __init__(self, model, optimizer, num_microbatches=None, mesh=None,
-                 donate=True, batch_spec=None):
+                 donate=True, batch_spec=None, schedule="1f1b"):
         super().__init__(model, loss_fn=None, optimizer=optimizer, mesh=mesh,
                          donate=donate, batch_spec=batch_spec)
         self.num_microbatches = num_microbatches
+        if schedule not in ("1f1b", "zero_bubble"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        self.schedule = schedule
 
     def _make_jit(self, params, buffers, opt_state, args_data):
         from .pipeline import pipeline_value_and_grad
@@ -240,7 +243,8 @@ class Pipeline1F1BTrainStep(DistributedTrainStep):
                 loss_sum, dsp, dex = pipeline_value_and_grad(
                     first_fn, mid_fn, last_fn, sp, ex, ids, labels, M,
                     mesh=mesh, param_specs=pspecs, extra_specs=especs,
-                    manual_axes=("pp", tp_axis) if tp_axis else ("pp",))
+                    manual_axes=("pp", tp_axis) if tp_axis else ("pp",),
+                    schedule=self.schedule)
                 ntok = jnp.asarray(ids.size, jnp.float32)
                 loss = loss_sum / ntok
                 by_name = dict(model.named_parameters())

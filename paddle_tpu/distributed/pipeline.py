@@ -36,7 +36,7 @@ def stack_spec(spec):
 
 def pipeline_apply(stage_fn, stage_params, x, num_microbatches, mesh=None,
                    remat=True, schedule="gpipe", num_chunks=1,
-                   remat_policy=None):
+                   remat_policy=None, with_aux=False):
     """Run `stage_fn(params_slice, h) -> h` as a P-stage pipeline.
 
     stage_params: pytree with leaves stacked [P, ...] (dim0 sharded on 'pp');
@@ -45,6 +45,14 @@ def pipeline_apply(stage_fn, stage_params, x, num_microbatches, mesh=None,
                   stacked index l) and stage_fn receives 1/num_chunks of the
                   layers per call.
     x:            [B, ...] input activations for stage 0 (replicated on 'pp')
+    with_aux:     stage_fn returns (h, aux_scalar) instead of h; aux is
+                  summed across stages and AVERAGED over microbatches (each
+                  stage counts only its active ticks), so a batch-mean-based
+                  aux (like the MoE load-balancing loss, O(1) regardless of
+                  token count) matches the pp=1 full-batch value instead of
+                  coming out ~M× larger.  The call returns (out, aux) —
+                  carrying e.g. the gate loss through the pipeline instead
+                  of dropping it (reference: moe/moe_layer.py).
     returns:      [B, ...] outputs of the last stage (replicated on 'pp')
 
     schedule='gpipe':       M+P-1 ticks forward; backward = XLA transpose of
@@ -59,31 +67,36 @@ def pipeline_apply(stage_fn, stage_params, x, num_microbatches, mesh=None,
     """
     mesh = mesh or get_mesh()
     pp = mesh.shape["pp"]
-    if pp == 1:
-        h = x
+
+    def _sequential(x):
+        h, aux = x, jnp.zeros((), jnp.float32)
         n = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
         for s in range(n):
             params = jax.tree_util.tree_map(lambda a, _s=s: a[_s],
                                             stage_params)
-            h = stage_fn(params, h)
-        return h
+            if with_aux:
+                h, a = stage_fn(params, h)
+                aux = aux + a
+            else:
+                h = stage_fn(params, h)
+        return (h, aux) if with_aux else h
+
+    if pp == 1:
+        return _sequential(x)
     from ..core.state import STATE
     if STATE.tracing_depth == 0:
         # eager (uncompiled): run stages sequentially — partial-manual
         # shard_map only exists under jit; semantics are identical
-        h = x
-        n = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
-        for s in range(n):
-            params = jax.tree_util.tree_map(lambda a, _s=s: a[_s],
-                                            stage_params)
-            h = stage_fn(params, h)
-        return h
+        return _sequential(x)
     M = num_microbatches
-    body = (jax.checkpoint(stage_fn, policy=remat_policy) if remat
-            else stage_fn)
+    fn = stage_fn if with_aux else (lambda sp, h:
+                                    (stage_fn(sp, h),
+                                     jnp.zeros((), jnp.float32)))
+    body = jax.checkpoint(fn, policy=remat_policy) if remat else fn
     if schedule == "interleaved" and num_chunks > 1:
-        return _interleaved_apply(body, stage_params, x, M, mesh, pp,
-                                  num_chunks)
+        out = _interleaved_apply(body, stage_params, x, M, mesh, pp,
+                                 num_chunks)
+        return out if with_aux else out[0]
 
     def inner(sp, xx):
         p = jax.lax.axis_index("pp")
@@ -95,28 +108,35 @@ def pipeline_apply(stage_fn, stage_params, x, num_microbatches, mesh=None,
         out0 = jnp.zeros_like(mbs)
 
         def step(carry, t):
-            state, out = carry
+            state, out, aux_sum = carry
             inp = jnp.where(p == 0, mbs[jnp.clip(t, 0, M - 1)], state)
-            y = body(sp, inp)
+            y, aux = body(sp, inp)
+            # stage p holds microbatch m = t - p; aux counts only valid ones
+            m = t - p
+            aux_sum = aux_sum + jnp.where((m >= 0) & (m < M), aux, 0.0)
             oidx = t - (pp - 1)
             is_out = (p == pp - 1) & (oidx >= 0)
             oclip = jnp.clip(oidx, 0, M - 1)
             out = out.at[oclip].set(jnp.where(is_out, y, out[oclip]))
             state = jax.lax.ppermute(
                 y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
-            return (state, out), None
+            return (state, out, aux_sum), None
 
-        (state, out), _ = jax.lax.scan(step, (state0, out0),
-                                       jnp.arange(M + pp - 1))
+        (state, out, aux_sum), _ = jax.lax.scan(
+            step, (state0, out0, jnp.zeros((), jnp.float32)),
+            jnp.arange(M + pp - 1))
         # outputs only live on the last stage; replicate via psum
         out = jax.lax.psum(out, "pp")
-        return out.reshape(xx.shape)
+        aux_sum = jax.lax.psum(aux_sum, "pp") / M  # microbatch mean
+        return out.reshape(xx.shape), aux_sum
 
     in_param_specs = jax.tree_util.tree_map(lambda a: P("pp"), stage_params)
     sm = jax.shard_map(inner, mesh=mesh,
                        in_specs=(in_param_specs, P()),
-                       out_specs=P(), axis_names={"pp"}, check_vma=False)
-    return sm(stage_params, x)
+                       out_specs=(P(), P()), axis_names={"pp"},
+                       check_vma=False)
+    out, aux = sm(stage_params, x)
+    return (out, aux) if with_aux else out
 
 
 def _interleaved_apply(body, stage_params, x, M, mesh, pp, V):
@@ -147,7 +167,7 @@ def _interleaved_apply(body, stage_params, x, M, mesh, pp, V):
         LP = V * pp  # logical stages
 
         def step(carry, t):
-            acts, out = carry
+            acts, out, aux_sum = carry
             # chunk v on device p is logical l = v*pp + p and processes
             # microbatch m = t - l when 0 <= m < M
             sends = []
@@ -163,8 +183,10 @@ def _interleaved_apply(body, stage_params, x, M, mesh, pp, V):
                     lambda: acts[v])
                 spv = jax.tree_util.tree_map(lambda a, _v=v: a[_v],
                                              sp_stacked)
-                y = jax.lax.cond(
-                    active, lambda iv: body(spv, iv), lambda iv: iv, inp)
+                y, aux = jax.lax.cond(
+                    active, lambda iv: body(spv, iv),
+                    lambda iv: (iv, jnp.zeros((), jnp.float32)), inp)
+                aux_sum = aux_sum + aux  # inactive branch contributes 0
                 sends.append(y)
                 is_last = (p == pp - 1) & (v == V - 1) & active
                 oclip = jnp.clip(m, 0, M - 1)
@@ -177,17 +199,20 @@ def _interleaved_apply(body, stage_params, x, M, mesh, pp, V):
             # feeds chunk v: shift the chunk axis by one
             shifted = jnp.roll(recv, 1, axis=0)
             acts = jnp.where(p == 0, shifted, recv)
-            return (acts, new_out), None
+            return (acts, new_out, aux_sum), None
 
         T = M + LP - 1
-        (acts, out), _ = jax.lax.scan(step, (acts0, out0), jnp.arange(T))
+        (acts, out, aux_sum), _ = jax.lax.scan(
+            step, (acts0, out0, jnp.zeros((), jnp.float32)), jnp.arange(T))
         out = jax.lax.psum(out, "pp")
-        return out.reshape(xx.shape)
+        aux_sum = jax.lax.psum(aux_sum, "pp") / M  # microbatch mean
+        return out.reshape(xx.shape), aux_sum
 
     in_param_specs = jax.tree_util.tree_map(lambda a: P("pp"), stage_params)
     sm = jax.shard_map(inner, mesh=mesh,
                        in_specs=(in_param_specs, P()),
-                       out_specs=P(), axis_names={"pp"}, check_vma=False)
+                       out_specs=(P(), P()), axis_names={"pp"},
+                       check_vma=False)
     return sm(stage_params, x)
 
 
@@ -238,10 +263,91 @@ def _b_sched(P, M, s, t):
     return m, (d >= 0) & (d % 2 == 0) & (m < M)
 
 
+def zero_bubble_tables(P, M):
+    """Static tick tables for the zero-bubble (ZB-H1-style) schedule.
+
+    Reference analogue: pipeline_zero_bubble.py
+    (distributed/passes/pipeline_scheduler_pass/) — backward is split into
+    dX (activation gradient, the inter-stage critical path) and W (weight
+    gradient, no cross-stage dependency).  F and dX keep the 1F1B tick
+    arithmetic; each stage's W steps fill its otherwise-idle ticks (at
+    least one tick after that microbatch's dX), with extra all-stages-busy
+    ticks appended at the end for leftovers.  Because a plain-1F1B B tick
+    does dX+dW back-to-back while the downstream stage waits, splitting
+    shortens the per-hop critical path: ticks go from
+    max(F, dX+dW)-deep to max(F, dX, W)-deep.
+
+    Returns dict with int32 arrays [T, P] (microbatch index, -1 = idle):
+    ``f``, ``b`` (dX), ``w``, plus ``T`` and the activation/grad ring depth
+    ``Q`` computed from actual slot lifetimes.
+    """
+    import numpy as np
+
+    def f_at(s, t):
+        w = P - s
+        d = t - s
+        if d < 0:
+            return -1
+        if d < min(w, M):
+            return d
+        if d % 2 == 0 and w <= d // 2 < M:
+            return d // 2
+        return -1
+
+    def b_at(s, t):
+        d = t - (2 * P - 1 - s)
+        if d >= 0 and d % 2 == 0 and d // 2 < M:
+            return d // 2
+        return -1
+
+    Tbase = 2 * (M + P - 1)
+    Tmax = Tbase + M + P  # always enough for leftovers
+    f = np.full((Tmax, P), -1, np.int32)
+    b = np.full((Tmax, P), -1, np.int32)
+    w = np.full((Tmax, P), -1, np.int32)
+    t_f = np.zeros((P, M), np.int64)
+    t_b = np.zeros((P, M), np.int64)
+    t_w = np.zeros((P, M), np.int64)
+    T = 0
+    for s in range(P):
+        pending = []  # microbatches whose dX ran, W not yet scheduled
+        for t in range(Tmax):
+            mf, mb = f_at(s, t), b_at(s, t)
+            f[t, s], b[t, s] = mf, mb
+            if mf >= 0:
+                t_f[s, mf] = t
+            if mb >= 0:
+                t_b[s, mb] = t
+            if mf < 0 and mb < 0 and pending:
+                m = pending.pop(0)
+                w[t, s] = m
+                t_w[s, m] = t
+            if mb >= 0:
+                pending.append(mb)
+            if not pending and t >= Tbase - 1:
+                break
+        T = max(T, t + 1)
+    f, b, w = f[:T], b[:T], w[:T]
+
+    # ring depth: slot m%Q must live from activation arrival (the tick
+    # after stage s-1's forward of m) / dX (for the grad buffer) until W(m)
+    Q = P + 1
+    for s in range(P):
+        for m in range(M):
+            birth = t_f[s - 1, m] + 1 if s > 0 else t_f[s, m]
+            concurrent = sum(
+                1 for m2 in range(M)
+                if not (t_w[s, m2] < birth
+                        or (t_f[s - 1, m2] + 1 if s > 0 else t_f[s, m2])
+                        > t_w[s, m]))
+            Q = max(Q, concurrent + 1)
+    return {"f": f, "b": b, "w": w, "T": T, "Q": int(Q)}
+
+
 def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
                             inputs, labels, num_microbatches, mesh=None,
                             param_specs=None, extra_specs=None,
-                            manual_axes=("pp",)):
+                            manual_axes=("pp",), schedule="1f1b"):
     """Compiled 1F1B training step core.
 
     first_fn(extras, mb_in) -> h        stage-0 prelude (e.g. embedding)
@@ -262,6 +368,9 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
                   and their collectives rendezvous safely.
 
     Returns (loss_sum_over_batch, d_stage_params, d_extras).
+
+    schedule: "1f1b" (default) or "zero_bubble" (dX/dW split — see
+    zero_bubble_tables).
     """
     mesh = mesh or get_mesh()
     Pstages = mesh.shape["pp"]
@@ -277,6 +386,11 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
             sp0, extras, inputs, labels)
         dsp = jax.tree_util.tree_map(lambda a: a[None], grads[0])
         return loss, dsp, grads[1]
+
+    if schedule == "zero_bubble":
+        return _zero_bubble_vag(first_fn, mid_fn, last_fn, stage_params,
+                                extras, inputs, labels, M, mesh, Pstages,
+                                param_specs, extra_specs, manual_axes)
 
     Q = Pstages + 1  # ring size: overwrite provably later than last use
 
@@ -389,6 +503,173 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
         T = 2 * (M + Pstages - 1)
         carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
         _, _, _, _, dsp, dex, loss_sum = carry
+        loss_sum = jax.lax.psum(loss_sum, "pp")
+        dex = jax.tree_util.tree_map(lambda a: jax.lax.psum(a, "pp"), dex)
+        dsp = jax.tree_util.tree_map(lambda a: a[None], dsp)
+        return loss_sum, dsp, dex
+
+    in_param_specs = (param_specs if param_specs is not None else
+                      jax.tree_util.tree_map(lambda a: P("pp"), stage_params))
+    ex_specs = (extra_specs if extra_specs is not None else
+                jax.tree_util.tree_map(lambda a: P(), extras))
+    sm = jax.shard_map(inner, mesh=mesh,
+                       in_specs=(in_param_specs, ex_specs, P(), P()),
+                       out_specs=(P(), in_param_specs, ex_specs),
+                       axis_names=set(manual_axes), check_vma=False)
+    return sm(stage_params, extras, inputs, labels)
+
+
+def _zero_bubble_vag(first_fn, mid_fn, last_fn, stage_params, extras,
+                     inputs, labels, M, mesh, Pstages, param_specs,
+                     extra_specs, manual_axes):
+    """Zero-bubble joint forward/backward scan (see zero_bubble_tables).
+
+    Differences from the 1F1B inner: a tick does at most one of
+    {F, dX, W}; dX computes ONLY the activation gradient
+    (vjp w.r.t. h — the cotangent hops to the previous stage immediately),
+    storing the incoming cotangent in a gradient ring buffer; W later
+    replays the stage forward and pulls the weight gradient
+    (vjp w.r.t. params).  The W replay is the remat the stage body
+    performs inside vjp anyway — deferring it off the critical path is
+    what shrinks the bubble."""
+    tables = zero_bubble_tables(Pstages, M)
+    T, Q = tables["T"], tables["Q"]
+    f_tab = jnp.asarray(tables["f"])
+    b_tab = jnp.asarray(tables["b"])
+    w_tab = jnp.asarray(tables["w"])
+
+    def inner(sp_stacked, ex, x, yl):
+        P_ = Pstages
+        p = jax.lax.axis_index("pp")
+        sp = jax.tree_util.tree_map(lambda a: a[0], sp_stacked)
+        b = x.shape[0]
+        mb = b // M
+        mbs = x.reshape(M, mb, *x.shape[1:])
+        lbs = yl.reshape(M, mb, *yl.shape[1:])
+
+        h_sd = jax.eval_shape(lambda m: mid_fn(sp, first_fn(ex, m)), mbs[0])
+        zero_h = jnp.zeros(h_sd.shape, h_sd.dtype)
+        h_buf0 = jnp.zeros((Q,) + h_sd.shape, h_sd.dtype)   # stage inputs
+        y_buf0 = jnp.zeros((Q,) + h_sd.shape, h_sd.dtype)   # last-stage outs
+        g_buf0 = jnp.zeros((Q,) + h_sd.shape, h_sd.dtype)   # dX cotangents
+        dsp0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape[1:], a.dtype), sp_stacked)
+        dex0 = jax.tree_util.tree_map(jnp.zeros_like, ex)
+
+        def tick(carry, t):
+            (h_buf, y_buf, g_buf, act_recv, grad_recv, dsp, dex,
+             loss_sum) = carry
+
+            # bank the activation received at the end of tick t-1
+            m_prev = jnp.where(t > 0, f_tab[jnp.maximum(t - 1, 0),
+                                            (p - 1) % P_], -1)
+            keep = (m_prev >= 0) & (p > 0)
+            slot = jnp.maximum(m_prev, 0) % Q
+            h_buf = h_buf.at[slot].set(
+                jnp.where(keep, act_recv, h_buf[slot]))
+
+            # ---------------- forward ----------------
+            m_f = f_tab[t, p]
+
+            def do_f(ops):
+                h_buf, y_buf = ops
+                inp = jax.lax.cond(
+                    p == 0,
+                    lambda: first_fn(ex, jax.lax.dynamic_index_in_dim(
+                        mbs, jnp.maximum(m_f, 0), 0,
+                        keepdims=False)).astype(h_sd.dtype),
+                    lambda: h_buf[jnp.maximum(m_f, 0) % Q])
+                y = mid_fn(sp, inp)
+                y_buf = y_buf.at[jnp.maximum(m_f, 0) % Q].set(
+                    jnp.where(p == P_ - 1, y, y_buf[jnp.maximum(m_f, 0) % Q]))
+                return h_buf, y_buf, y
+
+            h_buf, y_buf, send_act = jax.lax.cond(
+                m_f >= 0, do_f, lambda ops: (ops[0], ops[1], zero_h),
+                (h_buf, y_buf))
+
+            # ---------------- dX (activation gradient only) ----------------
+            m_b = b_tab[t, p]
+
+            def do_b(ops):
+                g_buf, grad_in, dex, loss_sum = ops
+                mbi = jnp.maximum(m_b, 0)
+                lb = jax.lax.dynamic_index_in_dim(lbs, mbi, 0,
+                                                  keepdims=False)
+
+                def last_g():
+                    yv = y_buf[mbi % Q]
+                    lv, pull = jax.vjp(
+                        lambda e, yy: last_fn(e, yy, lb), ex, yv)
+                    dex_l, gy = pull(jnp.ones((), lv.dtype))
+                    return gy.astype(h_sd.dtype), dex_l, \
+                        lv.astype(jnp.float32)
+
+                def mid_g():
+                    return grad_in, dex0, jnp.zeros((), jnp.float32)
+
+                gy, dex_c, lv = jax.lax.cond(p == P_ - 1, last_g, mid_g)
+                g_buf = g_buf.at[mbi % Q].set(gy)
+
+                def dx_mid():
+                    hin = h_buf[mbi % Q]
+                    _, pull = jax.vjp(lambda hh: mid_fn(sp, hh), hin)
+                    (dh,) = pull(gy)
+                    return dh.astype(h_sd.dtype)
+
+                # stage 0 sends nothing backward — its dX tick is just the
+                # cotangent bank (and, on the last stage, the loss head)
+                send_g = jax.lax.cond(p == 0, lambda: zero_h, dx_mid)
+                dex = jax.tree_util.tree_map(jnp.add, dex, dex_c)
+                return g_buf, dex, loss_sum + lv, send_g
+
+            g_buf, dex, loss_sum, send_grad = jax.lax.cond(
+                m_b >= 0, do_b,
+                lambda ops: (ops[0], ops[2], ops[3], zero_h),
+                (g_buf, grad_recv, dex, loss_sum))
+
+            # ---------------- W (weight gradient, off critical path) -------
+            m_w = w_tab[t, p]
+
+            def do_w(ops):
+                dsp, dex = ops
+                mwi = jnp.maximum(m_w, 0)
+                gy = g_buf[mwi % Q]
+
+                def w_first():
+                    mbv = jax.lax.dynamic_index_in_dim(mbs, mwi, 0,
+                                                       keepdims=False)
+                    _, pull = jax.vjp(
+                        lambda s_, e_: mid_fn(s_, first_fn(e_, mbv)
+                                              .astype(h_sd.dtype)), sp, ex)
+                    return pull(gy)
+
+                def w_mid():
+                    hin = h_buf[mwi % Q]
+                    _, pull = jax.vjp(lambda s_: mid_fn(s_, hin), sp)
+                    (dsp_c,) = pull(gy)
+                    return dsp_c, dex0
+
+                dsp_c, dex_c = jax.lax.cond(p == 0, w_first, w_mid)
+                dsp = jax.tree_util.tree_map(jnp.add, dsp, dsp_c)
+                dex = jax.tree_util.tree_map(jnp.add, dex, dex_c)
+                return dsp, dex
+
+            dsp, dex = jax.lax.cond(
+                m_w >= 0, do_w, lambda ops: ops, (dsp, dex))
+
+            # neighbor exchange (outside conds: unconditional under SPMD)
+            act_recv = jax.lax.ppermute(
+                send_act, "pp", [(i, (i + 1) % P_) for i in range(P_)])
+            grad_recv = jax.lax.ppermute(
+                send_grad, "pp", [(i, (i - 1) % P_) for i in range(P_)])
+            return (h_buf, y_buf, g_buf, act_recv, grad_recv, dsp, dex,
+                    loss_sum), None
+
+        carry0 = (h_buf0, y_buf0, g_buf0, zero_h, zero_h, dsp0, dex0,
+                  jnp.zeros((), jnp.float32))
+        carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+        _, _, _, _, _, dsp, dex, loss_sum = carry
         loss_sum = jax.lax.psum(loss_sum, "pp")
         dex = jax.tree_util.tree_map(lambda a: jax.lax.psum(a, "pp"), dex)
         dsp = jax.tree_util.tree_map(lambda a: a[None], dsp)
