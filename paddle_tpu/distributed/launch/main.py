@@ -82,5 +82,10 @@ def launch(argv=None):
     sys.exit(exit_code)
 
 
+def main(argv=None):
+    """Console-script entry (`fleetrun`, reference setup.py:1907)."""
+    launch(argv)
+
+
 if __name__ == "__main__":
     launch()

@@ -303,41 +303,156 @@ class CompiledTrainStep:
 
 
 # ---------------------------------------------------------------------------
-# save / load (reference: paddle.jit.save → program + params;
-# here: state_dict + layer pickle)
+# save / load — serialized-program deployment artifact.
+#
+# Reference analogue: paddle.jit.save → a Program + params that
+# fluid/jit/layer.h:44 (jit::Layer) reloads and runs WITHOUT the original
+# python class.  TPU-native twin: jax.export serializes the traced
+# StableHLO module (+ input/output tree specs) to `path + ".pdmodel"`, the
+# weights go to `path + ".pdparams"` (npz); `load` deserializes into a
+# TranslatedLayer whose __call__ executes the compiled program — no source
+# class needed, loadable in a fresh process.
 # ---------------------------------------------------------------------------
 def save(layer, path, input_spec=None, **configs):
-    import pickle
+    """Export `layer.forward` (or a StaticFunction) as a deployment artifact.
+
+    input_spec: list of paddle_tpu.static.InputSpec (or Tensors /
+    ShapeDtypeStructs) describing the forward arguments.  Required unless
+    the layer was called at least once through to_static (then the traced
+    signature is reused is NOT implemented — pass input_spec).
+    """
+    import json
     import numpy as np
-    state = {k: np.asarray(v._data) for k, v in layer.state_dict().items()}
-    meta = {"class": type(layer).__module__ + "." + type(layer).__qualname__}
-    with open(path + ".pdparams", "wb") as f:
-        pickle.dump(state, f)
+    from jax import export as jexport
+
+    fn = layer.forward if _is_layer(layer) else layer
+    target = layer if _is_layer(layer) else getattr(layer, "_layer", None)
+    if target is None:
+        raise ValueError("jit.save needs a Layer (or to_static-wrapped "
+                         "Layer method)")
+    if input_spec is None:
+        raise ValueError(
+            "jit.save requires input_spec=[InputSpec(shape, dtype), ...] "
+            "describing the forward arguments (reference: jit/api.py save)")
+
+    _sym_counter = [0]
+
+    def _to_struct(s):
+        if hasattr(s, "shape") and hasattr(s, "dtype"):
+            dims = []
+            for d in list(s.shape):
+                if d is None or (isinstance(d, int) and d < 0):
+                    # dynamic dim → jax.export symbolic dimension, so the
+                    # artifact accepts any size at that axis (paddle's
+                    # InputSpec([None, H]) dynamic-batch idiom)
+                    _sym_counter[0] += 1
+                    dims.append(f"_dyn{_sym_counter[0]}")
+                else:
+                    dims.append(str(int(d)))
+            dt = str(s.dtype)
+            if "int64" in dt:
+                # x64 is disabled framework-wide: int64 tensors ARE int32
+                import warnings
+                warnings.warn("jit.save: int64 input_spec exported as int32 "
+                              "(jax x64 disabled)", RuntimeWarning,
+                              stacklevel=3)
+            dt = {"paddle.float32": "float32", "paddle.int64": "int32",
+                  "int64": "int32"}.get(dt, dt)
+            from jax import export as jexport
+            shape = jexport.symbolic_shape(", ".join(dims)) \
+                if any(d.startswith("_dyn") for d in dims) \
+                else tuple(int(d) for d in dims)
+            return jax.ShapeDtypeStruct(shape, jnp.dtype(dt))
+        raise TypeError(f"unsupported input_spec entry: {s!r}")
+
+    structs = [_to_struct(s) for s in input_spec]
+    params, buffers = layer_state(target)
+    was_training = target.training
+    target.eval()
+
+    def pure(params, buffers, *xs):
+        bind_layer_state(target, params, buffers)
+        STATE.tracing_depth += 1
+        try:
+            with no_grad_guard():
+                out = fn(*[Tensor._wrap(x) for x in xs])
+        finally:
+            STATE.tracing_depth -= 1
+        return jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
     try:
-        with open(path + ".pdmodel", "wb") as f:
-            pickle.dump(layer, f)
-    except Exception:
-        with open(path + ".pdmodel", "wb") as f:
-            pickle.dump(meta, f)
-
-
-def load(path, **configs):
-    import pickle
-    import numpy as np
-    with open(path + ".pdmodel", "rb") as f:
-        obj = pickle.load(f)
-    with open(path + ".pdparams", "rb") as f:
-        state = pickle.load(f)
-    if _is_layer(obj):
-        obj.set_state_dict({k: jnp.asarray(v) for k, v in state.items()})
-        return obj
-    raise RuntimeError(
-        "paddle_tpu.jit.load: saved artifact is not reconstructible; "
-        "re-create the Layer and use set_state_dict")
+        p_structs = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+        b_structs = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers)
+        exported = jexport.export(jax.jit(pure))(p_structs, b_structs,
+                                                 *structs)
+        blob = exported.serialize()
+    finally:
+        bind_layer_state(target, params, buffers)
+        if was_training:
+            target.train()
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    np.savez(path + ".pdparams",
+             **{f"p##{k}": np.asarray(v) for k, v in params.items()},
+             **{f"b##{k}": np.asarray(v) for k, v in buffers.items()})
+    with open(path + ".pdmeta.json", "w") as f:
+        json.dump({"inputs": [[[str(d) for d in s.shape], str(s.dtype)]
+                              for s in structs],
+                   "format": "stablehlo-v1"}, f)
 
 
 class TranslatedLayer:
-    pass
+    """Runs a deserialized exported program (reference: jit::Layer,
+    fluid/jit/layer.h:44 + python TranslatedLayer, jit/translated_layer.py).
+    Holds weights + the compiled StableHLO module; no original class."""
+
+    def __init__(self, exported, params, buffers):
+        self._exported = exported
+        self._params = params
+        self._buffers = buffers
+        self.training = False
+
+    def __call__(self, *args):
+        xs = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+              for a in args]
+        out = self._exported.call(self._params, self._buffers, *xs)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor._wrap(a) if isinstance(a, jax.Array) else a,
+            out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def state_dict(self):
+        d = {k: Tensor._wrap(v) for k, v in self._params.items()}
+        d.update({k: Tensor._wrap(v) for k, v in self._buffers.items()})
+        return d
+
+
+def load(path, **configs):
+    """Load a jit.save artifact into a TranslatedLayer — works in a fresh
+    process without the original model class on the path."""
+    import numpy as np
+    from jax import export as jexport
+    with open(path + ".pdmodel", "rb") as f:
+        blob = f.read()
+    if blob[:1] == b"\x80":  # legacy pickle artifact (pre-stablehlo)
+        raise RuntimeError(
+            "this artifact was written by the old pickle-based jit.save; "
+            "re-export with the current version")
+    exported = jexport.deserialize(blob)
+    params, buffers = {}, {}
+    with np.load(path + ".pdparams.npz") as z:
+        for k in z.files:
+            kind, name = k.split("##", 1)
+            (params if kind == "p" else buffers)[name] = jnp.asarray(z[k])
+    return TranslatedLayer(exported, params, buffers)
 
 
 _static_mode = [False]

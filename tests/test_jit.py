@@ -104,12 +104,61 @@ class TestSaveLoad:
         assert np.allclose(np_t(loaded["weight"]), np_t(net.weight))
 
     def test_jit_save_load(self, tmp_path):
+        from paddle_tpu.static import InputSpec
         net = nn.Sequential(nn.Linear(2, 2))
         x = paddle.randn([1, 2])
         expected = np_t(net(x))
-        paddle.jit.save(net, str(tmp_path / "m"))
+        paddle.jit.save(net, str(tmp_path / "m"),
+                        input_spec=[InputSpec([1, 2], "float32")])
         net2 = paddle.jit.load(str(tmp_path / "m"))
-        assert np.allclose(np_t(net2(x)), expected)
+        assert np.allclose(np_t(net2(x)), expected, atol=1e-6)
+
+    def test_jit_save_load_fresh_process(self, tmp_path):
+        """The exported artifact must run WITHOUT the original class: load
+        + infer in a subprocess that never defines the model (reference:
+        jit::Layer deployment contract, fluid/jit/layer.h:44)."""
+        import subprocess
+        import sys
+        from paddle_tpu.static import InputSpec
+        net = nn.Sequential(nn.Linear(4, 3), nn.ReLU(), nn.Linear(3, 2))
+        x = paddle.randn([2, 4])
+        expected = np_t(net(x))
+        paddle.jit.save(net, str(tmp_path / "m"),
+                        input_spec=[InputSpec([2, 4], "float32")])
+        np.save(str(tmp_path / "x.npy"), np_t(x))
+        np.save(str(tmp_path / "want.npy"), expected)
+        code = f"""
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+m = paddle.jit.load({str(tmp_path / 'm')!r})
+x = paddle.to_tensor(np.load({str(tmp_path / 'x.npy')!r}))
+want = np.load({str(tmp_path / 'want.npy')!r})
+got = np.asarray(m(x).numpy())
+assert np.allclose(got, want, atol=1e-6), np.abs(got - want).max()
+print("OK")
+"""
+        import os
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=240,
+                           cwd=repo_root)
+        assert r.returncode == 0 and "OK" in r.stdout, (r.stdout, r.stderr)
+
+    def test_jit_save_dynamic_batch(self, tmp_path):
+        """InputSpec([None, H]) exports a symbolic batch dim — the artifact
+        serves any batch size."""
+        from paddle_tpu.static import InputSpec
+        net = nn.Sequential(nn.Linear(3, 2))
+        paddle.jit.save(net, str(tmp_path / "dyn"),
+                        input_spec=[InputSpec([None, 3], "float32")])
+        m = paddle.jit.load(str(tmp_path / "dyn"))
+        for b in (1, 4, 7):
+            x = paddle.randn([b, 3])
+            assert np.allclose(np_t(m(x)), np_t(net(x)), atol=1e-6)
 
     def test_optimizer_state_roundtrip(self, tmp_path):
         net = nn.Linear(2, 2)
