@@ -179,6 +179,8 @@ class DistributedTrainStep:
         bind_optimizer_state(self.optimizer, new_opt)
         if self.scaler is not None:
             self.scaler._absorb(new_sstate)
+        from .elastic import heartbeat
+        heartbeat()  # no-op unless under the elastic launcher
         return Tensor._wrap(loss)
 
 
@@ -230,21 +232,32 @@ class Pipeline1F1BTrainStep(DistributedTrainStep):
             M -= 1
 
         def step_fn(params, buffers, opt_state, lr, rng_key, sstate, args):
+            from ..tensor import random as _rnd
             ids, labels = args
             bind_layer_state(model, params, buffers)
             bind_optimizer_state(opt, opt_state)
             prev_lr = opt._learning_rate
             opt._learning_rate = lr
+            # thread the step's rng key (dropout keys derive from it via
+            # fold_in inside pipeline_parts); without this, _next_key()
+            # would split the GLOBAL generator inside the trace and leak a
+            # tracer into it
+            _rnd._TRACE_CHAIN[0] = _rnd._TraceKeyChain(rng_key)
             STATE.tracing_depth += 1
             try:
                 first_fn, mid_fn, last_fn, sp, ex, names, specs, fixup = \
                     model.pipeline_parts(tp_axis=tp_axis)
                 pspecs, especs = specs if specs is not None else (None, None)
+                # aux (MoE gate loss, pre-weighted in mid_fn) enters the
+                # schedule loss as aux * tokens/M so the /tokens below
+                # yields weight * mean-per-microbatch aux
+                aux_scale = (ids.size / M
+                             if getattr(mid_fn, "aux_aware", False) else None)
                 loss_sum, dsp, dex = pipeline_value_and_grad(
                     first_fn, mid_fn, last_fn, sp, ex, ids, labels, M,
                     mesh=mesh, param_specs=pspecs, extra_specs=especs,
                     manual_axes=("pp", tp_axis) if tp_axis else ("pp",),
-                    schedule=self.schedule)
+                    schedule=self.schedule, aux_scale=aux_scale)
                 ntok = jnp.asarray(ids.size, jnp.float32)
                 loss = loss_sum / ntok
                 by_name = dict(model.named_parameters())
@@ -266,6 +279,7 @@ class Pipeline1F1BTrainStep(DistributedTrainStep):
                 opt.clear_grad()
             finally:
                 STATE.tracing_depth -= 1
+                _rnd._TRACE_CHAIN[0] = None
                 opt._learning_rate = prev_lr
             new_params = {k: p._data for k, p in model.named_parameters()}
             new_buffers = {k: b._data for k, b in model.named_buffers()}

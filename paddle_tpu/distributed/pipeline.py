@@ -347,7 +347,8 @@ def zero_bubble_tables(P, M):
 def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
                             inputs, labels, num_microbatches, mesh=None,
                             param_specs=None, extra_specs=None,
-                            manual_axes=("pp",), schedule="1f1b"):
+                            manual_axes=("pp",), schedule="1f1b",
+                            aux_scale=None):
     """Compiled 1F1B training step core.
 
     first_fn(extras, mb_in) -> h        stage-0 prelude (e.g. embedding)
@@ -355,6 +356,18 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
                                         output shape == input shape
     last_fn(extras, h, mb_labels) -> l  final-stage head + loss (scalar,
                                         SUM-convention over the microbatch)
+
+    Contract extensions (opt-in via function attributes):
+    - ``mid_fn.mb_aware = True``: mid_fn is called as mid_fn(sp, h, m) with
+      the microbatch index — per-microbatch RNG threading (dropout under
+      1F1B; the reference replays RNG per micro-step,
+      fleet/recompute/recompute.py:109).  The backward/W replays pass the
+      same m, so masks replay deterministically.
+    - ``mid_fn.aux_aware = True``: mid_fn returns (h, aux_scalar); each
+      microbatch's aux (e.g. the MoE gate loss, pre-scaled by its weight)
+      is added to the loss as aux * aux_scale, and the backward uses
+      aux_scale as the aux cotangent.  Pass aux_scale = tokens/M so the
+      engine's final /tokens normalisation yields weight * mean(aux).
     stage_params: pytree, leaves stacked [P, ...] (dim0 on the 'pp' axis)
     extras:       pytree, replicated (embedding/head/final-norm weights)
     inputs/labels: [B, ...] arrays; B must divide into num_microbatches
@@ -375,12 +388,31 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
     mesh = mesh or get_mesh()
     Pstages = mesh.shape["pp"]
     M = int(num_microbatches)
+    mb_aware = getattr(mid_fn, "mb_aware", False)
+    aux_aware = getattr(mid_fn, "aux_aware", False)
+    aux_s = (jnp.asarray(aux_scale, jnp.float32) if aux_scale is not None
+             else jnp.ones((), jnp.float32))
+
+    def mid_call(sp, h, m):
+        """Normalized stage body: always (h, aux)."""
+        out = mid_fn(sp, h, m) if mb_aware else mid_fn(sp, h)
+        return out if aux_aware else (out, jnp.zeros((), jnp.float32))
 
     if Pstages == 1 and param_specs is None:
         sp0 = jax.tree_util.tree_map(lambda a: a[0], stage_params)
 
-        def whole(sp, ex, x, y):
-            return last_fn(ex, mid_fn(sp, first_fn(ex, x)), y)
+        if not (mb_aware or aux_aware):
+            def whole(sp, ex, x, y):
+                return last_fn(ex, mid_fn(sp, first_fn(ex, x)), y)
+        else:
+            def whole(sp, ex, x, y):
+                mbs = x.reshape(M, x.shape[0] // M, *x.shape[1:])
+                lbs = y.reshape(M, y.shape[0] // M, *y.shape[1:])
+                total = jnp.zeros((), jnp.float32)
+                for m in range(M):
+                    h, aux = mid_call(sp, first_fn(ex, mbs[m]), m)
+                    total = total + last_fn(ex, h, lbs[m]) + aux * aux_s
+                return total
 
         loss, grads = jax.value_and_grad(whole, argnums=(0, 1))(
             sp0, extras, inputs, labels)
@@ -388,9 +420,9 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
         return loss, dsp, grads[1]
 
     if schedule == "zero_bubble":
-        return _zero_bubble_vag(first_fn, mid_fn, last_fn, stage_params,
+        return _zero_bubble_vag(first_fn, mid_call, last_fn, stage_params,
                                 extras, inputs, labels, M, mesh, Pstages,
-                                param_specs, extra_specs, manual_axes)
+                                param_specs, extra_specs, manual_axes, aux_s)
 
     Q = Pstages + 1  # ring size: overwrite provably later than last use
 
@@ -403,7 +435,8 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
         mbs = x.reshape(M, mb, *x.shape[1:])
         lbs = yl.reshape(M, mb, *yl.shape[1:])
 
-        h_sd = jax.eval_shape(lambda m: mid_fn(sp, first_fn(ex, m)), mbs[0])
+        h_sd = jax.eval_shape(
+            lambda m: mid_call(sp, first_fn(ex, m), 0)[0], mbs[0])
         zero_h = jnp.zeros(h_sd.shape, h_sd.dtype)
         h_buf0 = jnp.zeros((Q,) + h_sd.shape, h_sd.dtype)   # stage inputs
         y_buf0 = jnp.zeros((Q,) + h_sd.shape, h_sd.dtype)   # last-stage outs
@@ -432,14 +465,17 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
                     lambda: first_fn(ex, jax.lax.dynamic_index_in_dim(
                         mbs, m_f, 0, keepdims=False)).astype(h_sd.dtype),
                     lambda: h_buf[m_f % Q])
-                y = mid_fn(sp, inp)
+                y, auxv = mid_call(sp, inp, m_f)
                 y_buf = y_buf.at[m_f % Q].set(
                     jnp.where(p == P_ - 1, y, y_buf[m_f % Q]))
-                return h_buf, y_buf, y
+                return h_buf, y_buf, y, auxv
 
-            h_buf, y_buf, send_act = jax.lax.cond(
-                F_act, do_f, lambda ops: (ops[0], ops[1], zero_h),
+            h_buf, y_buf, send_act, auxv = jax.lax.cond(
+                F_act, do_f,
+                lambda ops: (ops[0], ops[1], zero_h,
+                             jnp.zeros((), jnp.float32)),
                 (h_buf, y_buf))
+            loss_sum = loss_sum + auxv * aux_s
 
             # ---------------- backward step ----------------
             m_b, B_act = _b_sched(P_, M, p, t)
@@ -466,15 +502,17 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
                     mbv = jax.lax.dynamic_index_in_dim(mbs, m_b, 0,
                                                        keepdims=False)
                     _, pull = jax.vjp(
-                        lambda s_, e_: mid_fn(s_, first_fn(e_, mbv)
-                                              .astype(h_sd.dtype)), sp, ex)
-                    dsp_c, dex_c2 = pull(gy)
+                        lambda s_, e_: mid_call(s_, first_fn(e_, mbv)
+                                                .astype(h_sd.dtype), m_b),
+                        sp, ex)
+                    dsp_c, dex_c2 = pull((gy, aux_s))
                     return dsp_c, dex_c2, zero_h
 
                 def bwd_mid():
                     hin = h_buf[m_b % Q]
-                    _, pull = jax.vjp(lambda s_, hh: mid_fn(s_, hh), sp, hin)
-                    dsp_c, dh = pull(gy)
+                    _, pull = jax.vjp(
+                        lambda s_, hh: mid_call(s_, hh, m_b), sp, hin)
+                    dsp_c, dh = pull((gy, aux_s))
                     return dsp_c, dex0, dh.astype(h_sd.dtype)
 
                 dsp_c, dex_c2, send_g = jax.lax.cond(p == 0, bwd_first,
@@ -519,9 +557,9 @@ def pipeline_value_and_grad(first_fn, mid_fn, last_fn, stage_params, extras,
     return sm(stage_params, extras, inputs, labels)
 
 
-def _zero_bubble_vag(first_fn, mid_fn, last_fn, stage_params, extras,
+def _zero_bubble_vag(first_fn, mid_call, last_fn, stage_params, extras,
                      inputs, labels, M, mesh, Pstages, param_specs,
-                     extra_specs, manual_axes):
+                     extra_specs, manual_axes, aux_s):
     """Zero-bubble joint forward/backward scan (see zero_bubble_tables).
 
     Differences from the 1F1B inner: a tick does at most one of
@@ -547,7 +585,8 @@ def _zero_bubble_vag(first_fn, mid_fn, last_fn, stage_params, extras,
         mbs = x.reshape(M, mb, *x.shape[1:])
         lbs = yl.reshape(M, mb, *yl.shape[1:])
 
-        h_sd = jax.eval_shape(lambda m: mid_fn(sp, first_fn(ex, m)), mbs[0])
+        h_sd = jax.eval_shape(
+            lambda m: mid_call(sp, first_fn(ex, m), 0)[0], mbs[0])
         zero_h = jnp.zeros(h_sd.shape, h_sd.dtype)
         h_buf0 = jnp.zeros((Q,) + h_sd.shape, h_sd.dtype)   # stage inputs
         y_buf0 = jnp.zeros((Q,) + h_sd.shape, h_sd.dtype)   # last-stage outs
@@ -579,14 +618,18 @@ def _zero_bubble_vag(first_fn, mid_fn, last_fn, stage_params, extras,
                         mbs, jnp.maximum(m_f, 0), 0,
                         keepdims=False)).astype(h_sd.dtype),
                     lambda: h_buf[jnp.maximum(m_f, 0) % Q])
-                y = mid_fn(sp, inp)
+                y, auxv = mid_call(sp, inp, jnp.maximum(m_f, 0))
                 y_buf = y_buf.at[jnp.maximum(m_f, 0) % Q].set(
                     jnp.where(p == P_ - 1, y, y_buf[jnp.maximum(m_f, 0) % Q]))
-                return h_buf, y_buf, y
+                return h_buf, y_buf, y, auxv
 
-            h_buf, y_buf, send_act = jax.lax.cond(
-                m_f >= 0, do_f, lambda ops: (ops[0], ops[1], zero_h),
+            h_buf, y_buf, send_act, auxv = jax.lax.cond(
+                m_f >= 0, do_f,
+                lambda ops: (ops[0], ops[1], zero_h,
+                             jnp.zeros((), jnp.float32)),
                 (h_buf, y_buf))
+
+            loss_sum = loss_sum + auxv * aux_s
 
             # ---------------- dX (activation gradient only) ----------------
             m_b = b_tab[t, p]
@@ -613,8 +656,9 @@ def _zero_bubble_vag(first_fn, mid_fn, last_fn, stage_params, extras,
 
                 def dx_mid():
                     hin = h_buf[mbi % Q]
-                    _, pull = jax.vjp(lambda hh: mid_fn(sp, hh), hin)
-                    (dh,) = pull(gy)
+                    _, pull = jax.vjp(
+                        lambda hh: mid_call(sp, hh, mbi), hin)
+                    (dh,) = pull((gy, aux_s))
                     return dh.astype(h_sd.dtype)
 
                 # stage 0 sends nothing backward — its dX tick is just the
@@ -640,14 +684,16 @@ def _zero_bubble_vag(first_fn, mid_fn, last_fn, stage_params, extras,
                     mbv = jax.lax.dynamic_index_in_dim(mbs, mwi, 0,
                                                        keepdims=False)
                     _, pull = jax.vjp(
-                        lambda s_, e_: mid_fn(s_, first_fn(e_, mbv)
-                                              .astype(h_sd.dtype)), sp, ex)
-                    return pull(gy)
+                        lambda s_, e_: mid_call(s_, first_fn(e_, mbv)
+                                                .astype(h_sd.dtype), mwi),
+                        sp, ex)
+                    return pull((gy, aux_s))
 
                 def w_mid():
                     hin = h_buf[mwi % Q]
-                    _, pull = jax.vjp(lambda s_: mid_fn(s_, hin), sp)
-                    (dsp_c,) = pull(gy)
+                    _, pull = jax.vjp(
+                        lambda s_: mid_call(s_, hin, mwi), sp)
+                    (dsp_c,) = pull((gy, aux_s))
                     return dsp_c, dex0
 
                 dsp_c, dex_c = jax.lax.cond(p == 0, w_first, w_mid)

@@ -42,7 +42,8 @@ class GPTConfig:
                  use_flash_attention=True, recompute=False,
                  sequence_parallel=False, context_parallel=False,
                  num_experts=0, moe_every=2,
-                 moe_top_k=2, moe_capacity_factor=1.25, dtype="float32",
+                 moe_top_k=2, moe_capacity_factor=1.25,
+                 moe_aux_weight=0.01, dtype="float32",
                  tie_word_embeddings=True,
                  pp_schedule="gpipe", virtual_pp_degree=1):
         self.vocab_size = vocab_size
@@ -67,6 +68,9 @@ class GPTConfig:
         self.moe_every = moe_every
         self.moe_top_k = moe_top_k
         self.moe_capacity_factor = moe_capacity_factor
+        # gate-loss weight folded into the 1F1B objective (the schedule owns
+        # the loss there; on GSPMD paths users add moe_aux_loss() manually)
+        self.moe_aux_weight = moe_aux_weight
         self.dtype = dtype
         self.tie_word_embeddings = tie_word_embeddings
         # pipeline schedule: 'gpipe' | 'interleaved' (reference:
@@ -85,6 +89,13 @@ class GPTConfig:
         return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
 
     @staticmethod
+    def gpt3_760m(**kw):
+        # "GPT-3 Large" — the largest config whose AdamW training state
+        # (bf16 params + fp32 master + 2 fp32 moments ~ 10.6 GB) fits a
+        # single 16G v5e chip with activation headroom
+        return GPTConfig(hidden_size=1536, num_layers=24, num_heads=16, **kw)
+
+    @staticmethod
     def gpt3_1_3b(**kw):
         return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16, **kw)
 
@@ -93,11 +104,26 @@ class GPTConfig:
         return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32, **kw)
 
 
+def _sel_policy(mode):
+    """Remat policy for selective recompute: which checkpoint_name'd
+    activations survive to backward (the rest replay)."""
+    names = (("qkv", "attn_out") if mode == "selective_lean"
+             else ("qkv", "attn_out", "ffn_up"))
+    return jax.checkpoint_policies.save_only_these_names(*names)
+
+
 def _norm(x, w, b, eps):
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, -1, keepdims=True)
     var = jnp.var(xf, -1, keepdims=True)
     return ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+def _dropout(x, key, p):
+    """Inverted dropout (shared by the GSPMD block and the manual-TP
+    block so the two paths can never drift numerically)."""
+    return jnp.where(jax.random.bernoulli(key, 1 - p, x.shape),
+                     x / (1 - p), 0.0).astype(x.dtype)
 
 
 class GPTForCausalLM(Layer):
@@ -215,15 +241,13 @@ class GPTForCausalLM(Layer):
             a = attention(x, lw)
             if drop > 0:
                 key, k1 = jax.random.split(key)
-                a = jnp.where(jax.random.bernoulli(k1, 1 - drop, a.shape),
-                              a / (1 - drop), 0.0).astype(a.dtype)
+                a = _dropout(a, k1, drop)
             h = h + a
             x = _norm(h, lw["ln2_w"], lw["ln2_b"], eps)
             f, aux = ffn(x, lw)
             if drop > 0:
                 key, k2 = jax.random.split(key)
-                f = jnp.where(jax.random.bernoulli(k2, 1 - drop, f.shape),
-                              f / (1 - drop), 0.0).astype(f.dtype)
+                f = _dropout(f, k2, drop)
             h = h + f
             if c.sequence_parallel:
                 mesh = get_mesh()
@@ -311,9 +335,8 @@ class GPTForCausalLM(Layer):
                         f"{ids.shape[0]} not divisible by {2 * pp}); bubble "
                         f"fraction increases — prefer batch % {2 * pp} == 0",
                         RuntimeWarning, stacklevel=2)
-                sel_policy = (jax.checkpoint_policies.save_only_these_names(
-                    "qkv", "attn_out", "ffn_up")
-                    if c.recompute == "selective" else None)
+                sel_policy = (_sel_policy(c.recompute) if c.recompute in
+                              ("selective", "selective_lean") else None)
                 h = pipeline_apply(stage_fn, stage_params, h, M,
                                    remat=bool(c.recompute),
                                    schedule=c.pp_schedule
@@ -331,15 +354,16 @@ class GPTForCausalLM(Layer):
                     return hh, (aux if aux is not None
                                 else jnp.zeros((), jnp.float32))
                 scan_body = body
-                if c.recompute == "selective":
+                if c.recompute in ("selective", "selective_lean"):
                     # Megatron-style selective recompute (reference:
                     # fleet/recompute 'full' vs refined recompute): save only
                     # the expensive matmul outputs; ln/gelu/flash replay in
                     # bwd.  ~6% extra FLOPs for ~85% of full-remat's memory
-                    # saving.
+                    # saving.  'selective_lean' also drops the 4H-wide
+                    # ffn_up (halves saved bytes; fc1 replays in bwd,
+                    # ~+4% step FLOPs) — it buys a bigger batch at 760M+.
                     scan_body = jax.checkpoint(
-                        body, policy=jax.checkpoint_policies.
-                        save_only_these_names("qkv", "attn_out", "ffn_up"))
+                        body, policy=_sel_policy(c.recompute))
                 elif c.recompute:
                     scan_body = jax.checkpoint(body)
                 h, auxs = jax.lax.scan(scan_body, h, (lws, keys))
@@ -416,19 +440,20 @@ class GPTForCausalLM(Layer):
         if L % pp != 0:
             raise ValueError(f"num_layers {L} not divisible by pp {pp}")
         lpp = L // pp
-        if self.training and c.dropout > 0:
-            raise NotImplementedError(
-                "dropout under the 1F1B schedule needs per-microbatch RNG "
-                "threading; train with dropout=0 or use pp_schedule='gpipe'")
         names = self._stacked()
         eps = c.layer_norm_epsilon
         tie = c.tie_word_embeddings
         use_rope = c.use_rope
+        use_dropout = self.training and c.dropout > 0
+        moe = c.num_experts > 0
 
         if tp_axis is not None:
             return self._pipeline_parts_tp(tp_axis, pp, lpp)
 
         block = self._block_fn(c, self.training, None)
+        if use_dropout:
+            from ..tensor.random import _next_key
+            dkey = _next_key()
 
         stage_params = {
             n: getattr(self, n)._data.reshape(
@@ -447,12 +472,31 @@ class GPTForCausalLM(Layer):
                 h = h + jnp.take(ex["wpe"], jnp.arange(ids.shape[1]), axis=0)
             return h
 
-        def mid_fn(sp, h):
-            def body(hh, lw):
-                hh, _aux = block(hh, (lw, None))  # aux dropped under pp
-                return hh, None
-            h, _ = jax.lax.scan(body, h, sp)
-            return h
+        def mid_fn(sp, h, m=0):
+            # per-(microbatch, global layer) dropout keys: fold_in replays
+            # identically in the backward/W vjps of the schedule (the
+            # reference's RNG replay, fleet/recompute/recompute.py:109)
+            stage = jax.lax.axis_index("pp") if pp > 1 else 0
+
+            def body(carry, xs):
+                hh, aux_sum = carry
+                lw, li = xs
+                key = None
+                if use_dropout:
+                    key = jax.random.fold_in(
+                        jax.random.fold_in(dkey, m), stage * lpp + li)
+                hh, aux = block(hh, (lw, key))
+                if aux is not None:
+                    aux_sum = aux_sum + aux
+                return (hh, aux_sum), None
+
+            (h, aux), _ = jax.lax.scan(
+                body, (h, jnp.zeros((), jnp.float32)),
+                (sp, jnp.arange(lpp)))
+            return (h, aux * c.moe_aux_weight) if moe else h
+
+        mid_fn.mb_aware = use_dropout
+        mid_fn.aux_aware = moe
 
         def last_fn(ex, h, labels):
             h = _norm(h, ex["lnf_w"], ex["lnf_b"], eps)
@@ -539,7 +583,13 @@ class GPTForCausalLM(Layer):
         if not tie:
             extra_specs["head"] = P_(None, ax)
 
-        def block_tp(h, lw):
+        use_dropout = self.training and c.dropout > 0
+        drop = c.dropout if use_dropout else 0.0
+        if use_dropout:
+            from ..tensor.random import _next_key
+            dkey = _next_key()
+
+        def block_tp(h, lw, key):
             b, s, _ = h.shape
             x = _norm(h, lw["ln1_w"], lw["ln1_b"], eps)
             x = copy_to_mp(x, ax)
@@ -562,6 +612,11 @@ class GPTForCausalLM(Layer):
             a = reduce_from_mp(
                 jnp.matmul(o, lw["proj_w"], precision=matmul_precision()),
                 ax) + lw["proj_b"]
+            if drop > 0:
+                # key depends only on (microbatch, layer): every mp member
+                # draws the SAME mask on the full (post-psum) activation
+                key, k1 = jax.random.split(key)
+                a = _dropout(a, k1, drop)
             h = h + a
             x = _norm(h, lw["ln2_w"], lw["ln2_b"], eps)
             x = copy_to_mp(x, ax)
@@ -571,6 +626,9 @@ class GPTForCausalLM(Layer):
                 jnp.matmul(jax.nn.gelu(up), lw["fc2_w"],
                            precision=matmul_precision()),
                 ax) + lw["fc2_b"]
+            if drop > 0:
+                key, k2 = jax.random.split(key)
+                f = _dropout(f, k2, drop)
             return h + f
 
         def first_fn(ex, ids):
@@ -579,11 +637,21 @@ class GPTForCausalLM(Layer):
                 h = h + jnp.take(ex["wpe"], jnp.arange(ids.shape[1]), axis=0)
             return h
 
-        def mid_fn(sp, h):
-            def body(hh, lw):
-                return block_tp(hh, lw), None
-            h, _ = jax.lax.scan(body, h, sp)
+        def mid_fn(sp, h, m=0):
+            stage = jax.lax.axis_index("pp")
+
+            def body(carry, xs):
+                lw, li = xs
+                key = None
+                if use_dropout:
+                    key = jax.random.fold_in(
+                        jax.random.fold_in(dkey, m), stage * lpp + li)
+                return block_tp(carry, lw, key), None
+
+            h, _ = jax.lax.scan(body, h, (sp, jnp.arange(lpp)))
             return h
+
+        mid_fn.mb_aware = use_dropout
 
         def last_fn(ex, h, labels):
             hn = _norm(h, ex["lnf_w"], ex["lnf_b"], eps)
