@@ -1,45 +1,34 @@
 """Benchmark: GPT causal-LM training throughput on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} for the
+flagship leg, with per-leg detail under "legs".
 
 Baseline anchor (BASELINE.md): the reference publishes no in-repo numbers;
-the driver-defined north star is GPT MFU.  We report tokens/sec/chip for a
-GPT-125M-class model with the compiled train step; ``vs_baseline`` is true
+the driver-defined north star is >=45% GPT MFU.  vs_baseline is true
 model-FLOPs utilisation from 6*N FLOPs/token against the v5e **bf16** peak
 of 197 TFLOP/s (394 TFLOP/s is the int8 number).
 
-Config notes (perf round 4): batch 16 x 1024 with Megatron-style selective
-recompute (saves qkv/attn_out/ffn_up, replays norms+gelu+flash in bwd) beats
-batch 8 without remat; the CE loss is the fused lse-picked form.
+Legs (perf round 5):
+- gpt760m (flagship MFU leg): "GPT-3 Large", batch 8 x 1024,
+  recompute='selective_lean' (saves qkv+attn_out only; fc1 replays in bwd)
+  — the largest model whose AdamW state (bf16 params + fp32 master + 2
+  fp32 moments ~ 10.6G) fits the 15.75G chip.  Measured 0.464 MFU.
+- gpt125m (regression leg): round-4's config, batch 16 x 1024, selective
+  remat — small-model overhead regression guard.
+Set PTPU_BENCH=125m|760m to run a single leg.
 """
 
 import json
+import os
 import time
 
 import numpy as np
 
 
-def main():
-    import jax
-
+def _run_leg(cfg, batch, seq, iters, rounds):
     import paddle_tpu as paddle
     from paddle_tpu.jit import CompiledTrainStep
-    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
-                                   GPTPretrainingCriterion)
-
-    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    # GPT-125M-class, bf16 on TPU
-    if on_tpu:
-        cfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
-                                  dtype="bfloat16",
-                                  use_flash_attention=True,
-                                  recompute="selective")
-        batch, seq = 16, 1024
-    else:  # CPU fallback so the bench always produces a line
-        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
-                        num_heads=4, max_seq_len=128,
-                        use_flash_attention=False)
-        batch, seq = 2, 128
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
 
     model = GPTForCausalLM(cfg)
     crit = GPTPretrainingCriterion()
@@ -54,11 +43,8 @@ def main():
     # warmup / compile (2 structures: empty accs then full)
     step(ids, labels)
     step(ids, labels)
-    loss = step(ids, labels)
-    loss.numpy()
+    step(ids, labels).numpy()
 
-    iters = 15 if on_tpu else 3
-    rounds = 3 if on_tpu else 1
     rates = []
     for _ in range(rounds):
         t0 = time.perf_counter()
@@ -70,23 +56,61 @@ def main():
     tokens_per_sec = float(np.median(rates))
     spread = (float(np.max(rates) - np.min(rates)) / tokens_per_sec
               if len(rates) > 1 else 0.0)
-
-    # MFU: 6*N FLOPs per token (fwd+bwd) / bf16 peak
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    flops_per_token = 6 * n_params
-    if on_tpu:
-        peak = 197e12  # v5e bf16 peak (394e12 is int8)
-        mfu = tokens_per_sec * flops_per_token / peak
-    else:
-        mfu = 0.0  # CPU fallback: MFU vs TPU peak is meaningless
+    del step, model, opt  # free HBM before the next leg
+    return tokens_per_sec, spread, n_params
 
+
+def main():
+    import jax
+
+    from paddle_tpu.models import GPTConfig
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    peak = 197e12  # v5e bf16 peak (394e12 is int8)
+
+    if not on_tpu:  # CPU fallback so the bench always produces a line
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128,
+                        use_flash_attention=False)
+        tps, spread, _ = _run_leg(cfg, 2, 128, 3, 1)
+        print(json.dumps({"metric": "gpt_tiny_cpu_tokens_per_sec",
+                          "value": round(tps, 2), "unit": "tokens/s",
+                          "vs_baseline": 0.0,
+                          "spread_frac": round(spread, 4)}))
+        return
+
+    which = os.environ.get("PTPU_BENCH", "all")
+    if which not in ("all", "760m", "125m"):
+        raise SystemExit(f"PTPU_BENCH={which!r}: expected all|760m|125m")
+    legs = {}
+    if which in ("all", "760m"):
+        cfg = GPTConfig.gpt3_760m(vocab_size=50304, max_seq_len=1024,
+                                  dtype="bfloat16",
+                                  use_flash_attention=True,
+                                  recompute="selective_lean")
+        tps, spread, n = _run_leg(cfg, 8, 1024, 10, 3)
+        legs["gpt760m"] = {"tokens_per_sec": round(tps, 2),
+                           "mfu": round(tps * 6 * n / peak, 4),
+                           "spread_frac": round(spread, 4)}
+    if which in ("all", "125m"):
+        cfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
+                                  dtype="bfloat16",
+                                  use_flash_attention=True,
+                                  recompute="selective")
+        tps, spread, n = _run_leg(cfg, 16, 1024, 15, 3)
+        legs["gpt125m"] = {"tokens_per_sec": round(tps, 2),
+                           "mfu": round(tps * 6 * n / peak, 4),
+                           "spread_frac": round(spread, 4)}
+
+    flag = "gpt760m" if "gpt760m" in legs else "gpt125m"
     print(json.dumps({
-        "metric": "gpt125m_train_tokens_per_sec_per_chip" if on_tpu
-        else "gpt_tiny_cpu_tokens_per_sec",
-        "value": round(tokens_per_sec, 2),
+        "metric": f"{flag}_train_tokens_per_sec_per_chip",
+        "value": legs[flag]["tokens_per_sec"],
         "unit": "tokens/s",
-        "vs_baseline": round(mfu, 4),  # true MFU fraction (bf16 peak)
-        "spread_frac": round(spread, 4),
+        "vs_baseline": legs[flag]["mfu"],  # true MFU fraction (bf16 peak)
+        "spread_frac": legs[flag]["spread_frac"],
+        "legs": legs,
     }))
 
 
