@@ -1,0 +1,174 @@
+"""Elastic training: worker supervision, heartbeat watchdog, relaunch.
+
+Reference analogue: fleet/elastic/manager.py:124 (ElasticManager — etcd
+heartbeats, scale/fault events, relaunch) and the comm-task watchdog
+paddle/phi/core/distributed/comm_task_manager.cc:171-217 (periodic scan,
+abort on timeout).
+
+TPU-native redesign: no etcd — a single-host (or per-host) supervisor owns
+the worker processes directly, heartbeats are mtime touches on per-rank
+files (the training step touches them; a wedged XLA program stops
+touching), and recovery = kill the world, relaunch with the surviving
+resources, resume from the distributed checkpoint
+(distributed/checkpoint reshard-on-load handles a changed world size).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_HEARTBEAT_ENV = "PADDLE_ELASTIC_HEARTBEAT_FILE"
+
+
+def heartbeat():
+    """Touch this worker's heartbeat file (no-op outside elastic runs).
+    Called automatically by the compiled train steps each step; safe to
+    call from any training loop."""
+    path = os.environ.get(_HEARTBEAT_ENV)
+    if not path:
+        return
+    try:
+        with open(path, "a"):
+            os.utime(path, None)
+    except OSError:
+        pass
+
+
+class ElasticAgent:
+    """Supervise `nproc` worker processes with restart-on-failure.
+
+    - A worker exiting nonzero (or a heartbeat going stale for longer than
+      ``heartbeat_timeout`` seconds) kills the whole world and relaunches
+      it, up to ``max_restarts`` times.  ``PADDLE_RESTART_COUNT`` tells
+      workers which incarnation they are (scripts use it to decide to
+      resume from checkpoint).
+    - Shrinkable worlds: if ``min_nproc`` < nproc and the same rank fails
+      twice in a row, the relaunch drops to the surviving count —
+      reshard-on-load absorbs the new world size.
+    """
+
+    def __init__(self, cmd, nproc, log_dir="log", max_restarts=3,
+                 heartbeat_timeout=None, min_nproc=None, env=None,
+                 master=None, poll_interval=0.5):
+        self.cmd = cmd
+        self.nproc = nproc
+        self.log_dir = log_dir
+        self.max_restarts = max_restarts
+        self.heartbeat_timeout = heartbeat_timeout
+        self.min_nproc = min_nproc or nproc
+        self.base_env = dict(env if env is not None else os.environ)
+        self.master = master
+        self.poll_interval = poll_interval
+        self.restart_count = 0
+        self.events = []  # (wallclock, kind, detail) — observability
+
+    # -- one incarnation -----------------------------------------------------
+    def _spawn(self, nproc):
+        os.makedirs(self.log_dir, exist_ok=True)
+        procs = []
+        for rank in range(nproc):
+            env = dict(self.base_env)
+            hb = os.path.join(self.log_dir, f"heartbeat.{rank}")
+            try:
+                os.unlink(hb)
+            except OSError:
+                pass
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(nproc),
+                "PADDLE_LOCAL_RANK": str(rank),
+                "PADDLE_RESTART_COUNT": str(self.restart_count),
+                "FLAGS_selected_tpus": str(rank),
+                _HEARTBEAT_ENV: hb,
+            })
+            if self.master:
+                env["PADDLE_MASTER"] = self.master
+                env["COORDINATOR_ADDRESS"] = self.master
+            log = open(os.path.join(
+                self.log_dir,
+                f"workerlog.{rank}.r{self.restart_count}"), "w")
+            procs.append({
+                "proc": subprocess.Popen(self.cmd, env=env, stdout=log,
+                                         stderr=subprocess.STDOUT),
+                "log": log, "hb": hb, "rank": rank, "start": time.time(),
+            })
+        return procs
+
+    def _kill_all(self, procs):
+        for w in procs:
+            if w["proc"].poll() is None:
+                w["proc"].send_signal(signal.SIGTERM)
+        deadline = time.time() + 5
+        for w in procs:
+            timeout = max(0.1, deadline - time.time())
+            try:
+                w["proc"].wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                w["proc"].kill()
+        for w in procs:
+            w["log"].close()
+
+    def _check(self, procs):
+        """Returns (status, detail, failed_rank)."""
+        codes = [w["proc"].poll() for w in procs]
+        if any(c is not None and c != 0 for c in codes):
+            bad = [(w["rank"], c) for w, c in zip(procs, codes)
+                   if c is not None and c != 0]
+            return "failed", f"worker exit codes {bad}", bad[0][0]
+        if all(c == 0 for c in codes):
+            return "done", "", None
+        if self.heartbeat_timeout:
+            now = time.time()
+            for w in procs:
+                if w["proc"].poll() is not None:
+                    continue
+                try:
+                    last = os.path.getmtime(w["hb"])
+                except OSError:
+                    # no heartbeat yet: the worker is still importing /
+                    # compiling — the clock starts at the FIRST heartbeat
+                    # (startup hangs are caught by exit codes, not the
+                    # watchdog; compile time is unbounded-ish on TPU)
+                    continue
+                if now - last > self.heartbeat_timeout:
+                    return "failed", (
+                        f"rank {w['rank']} heartbeat stale "
+                        f"{now - last:.1f}s > {self.heartbeat_timeout}s "
+                        "(hung step / dead collective)"), w["rank"]
+        return "running", "", None
+
+    # -- supervision loop ----------------------------------------------------
+    def run(self):
+        nproc = self.nproc
+        last_failed_rank = None
+        while True:
+            self.events.append((time.time(), "launch",
+                                f"nproc={nproc} restart={self.restart_count}"))
+            procs = self._spawn(nproc)
+            status, detail, failed_rank = "running", "", None
+            try:
+                while status == "running":
+                    time.sleep(self.poll_interval)
+                    status, detail, failed_rank = self._check(procs)
+            finally:
+                self._kill_all(procs)
+            if status == "done":
+                self.events.append((time.time(), "done", ""))
+                return 0
+            self.events.append((time.time(), "failure", detail))
+            if self.restart_count >= self.max_restarts:
+                self.events.append((time.time(), "giveup",
+                                    f"after {self.restart_count} restarts"))
+                return 1
+            # the SAME rank failing twice in a row looks like a bad/lost
+            # resource, not a transient fault → shrink if allowed
+            if (failed_rank is not None and failed_rank == last_failed_rank
+                    and nproc > self.min_nproc):
+                nproc -= 1
+                self.events.append((time.time(), "shrink", f"nproc={nproc}"))
+            last_failed_rank = failed_rank
+            self.restart_count += 1

@@ -32,6 +32,17 @@ def parse_args(argv=None):
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--job_id", type=str, default="default")
     p.add_argument("--devices", "--gpus", type=str, default=None)
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise workers: restart the world on worker "
+                        "failure or stale heartbeat (reference: fleet "
+                        "elastic manager)")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--heartbeat_timeout", type=float, default=None,
+                   help="seconds without a train-step heartbeat before a "
+                        "worker counts as hung (watchdog; needs --elastic)")
+    p.add_argument("--min_nproc", type=int, default=None,
+                   help="allow the world to shrink to this size after "
+                        "repeated failures (resume reshards the checkpoint)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -41,6 +52,22 @@ def launch(argv=None):
     args = parse_args(argv)
     nproc = args.nproc_per_node
     master = args.master or f"127.0.0.1:{_free_port()}"
+    if args.elastic:
+        if int(args.nnodes.split(":")[0]) > 1 or args.rank != 0:
+            raise NotImplementedError(
+                "--elastic currently supervises a single host "
+                "(per-host agents with a shared store are the multi-node "
+                "path); run one launcher per host without --elastic, or "
+                "drop --nnodes/--rank")
+        from ..elastic import ElasticAgent
+        agent = ElasticAgent(
+            [sys.executable, args.training_script]
+            + args.training_script_args,
+            nproc, log_dir=args.log_dir, max_restarts=args.max_restarts,
+            heartbeat_timeout=args.heartbeat_timeout,
+            min_nproc=args.min_nproc,
+            master=master if nproc > 1 else None)
+        sys.exit(agent.run())
     os.makedirs(args.log_dir, exist_ok=True)
     procs = []
     base_env = dict(os.environ)

@@ -299,6 +299,8 @@ class CompiledTrainStep:
         if isinstance(self.optimizer._learning_rate, object) and hasattr(
                 self.optimizer._learning_rate, "step"):
             pass  # scheduler stepped by user (paddle semantics)
+        from ..distributed.elastic import heartbeat
+        heartbeat()  # no-op unless under the elastic launcher
         return Tensor._wrap(loss)
 
 
