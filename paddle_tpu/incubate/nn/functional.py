@@ -203,9 +203,79 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
 
 
 def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
-                               **kwargs):
-    raise NotImplementedError(
-        "decode-time MMHA: use paddle_tpu.nn.MultiHeadAttention with cache")
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", **kwargs):
+    """Decode-step multi-head attention against a KV cache.
+
+    Reference: phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu —
+    one query token per sequence attends to everything cached so far; the
+    new K/V slot is appended in place.
+
+    x:         [B, 3*H] fused qkv for the current step.
+    cache_kv:  [2, B, num_heads, S_max, head_dim]; if `sequence_lengths`
+               ([B] or [B, 1] int) is given the new token lands at that
+               position per row, else at the first all-zero slot is NOT
+               inferred — pass sequence_lengths (the reference requires the
+               offset too).
+    Returns (out [B, H], updated cache_kv) — matching the reference's
+    (out, cache_kv_out) pair.  The rotary/int8/beam parameters of the CUDA
+    kernel are not implemented and are rejected explicitly."""
+    for name, val in (("rotary_tensor", rotary_tensor),
+                      ("beam_cache_offset", beam_cache_offset),
+                      ("qkv_out_scale", qkv_out_scale),
+                      ("out_shift", out_shift), ("out_smooth", out_smooth)):
+        if val is not None:
+            raise NotImplementedError(
+                f"masked_multihead_attention: {name} is not supported on "
+                "the TPU path (apply RoPE to qkv before the call; int8 "
+                "requantization and beam search are CUDA-kernel specific)")
+
+    def fn(xv, cache, *rest):
+        it = iter(rest)
+        seqlens = next(it) if sequence_lengths is not None else None
+        nh = cache.shape[2]
+        hd = cache.shape[4]
+        B = xv.shape[0]
+        qkv = xv.reshape(B, 3, nh, hd)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # [B, nh, hd]
+        if seqlens is None:
+            raise ValueError(
+                "masked_multihead_attention needs sequence_lengths (the "
+                "per-row cache write position)")
+        pos = seqlens.reshape(B).astype(jnp.int32)   # [B]
+        S = cache.shape[3]
+        if not isinstance(pos, jax.core.Tracer):
+            import numpy as _np
+            if int(_np.max(_np.asarray(pos))) >= S:
+                raise ValueError(
+                    f"sequence_lengths {pos} exceed cache capacity {S}")
+        # OVERWRITE the slot (the reference kernel stores, not adds —
+        # re-decoding a position must not sum stale K/V)
+        onehot = jax.nn.one_hot(pos, S, dtype=cache.dtype)  # [B, S]
+        sel = onehot[:, None, :, None]
+        ck = cache[0] * (1 - sel) + sel * k[:, :, None, :]
+        cv = cache[1] * (1 - sel) + sel * v[:, :, None, :]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        logits = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32) * scale,
+                            ck.astype(jnp.float32))
+        mask = jnp.arange(S)[None, :] <= pos[:, None]        # [B, S]
+        logits = jnp.where(mask[:, None, :], logits, -1e30)
+        if src_mask is not None:
+            sm = next(it)
+            logits = logits + sm.reshape(B, 1, -1)[..., :S]
+        p = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("bhs,bhsd->bhd", p.astype(cv.dtype), cv)
+        return o.reshape(B, nh * hd), jnp.stack([ck, cv])
+
+    extras = []
+    if sequence_lengths is not None:
+        extras.append(sequence_lengths)
+    if src_mask is not None:
+        extras.append(src_mask)
+    return apply_op("masked_multihead_attention", fn, x, cache_kv, *extras)
 
 
 def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,

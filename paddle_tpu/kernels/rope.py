@@ -8,19 +8,21 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rope_tables(seq_len, head_dim, base=10000.0, dtype=jnp.float32):
+def rope_tables(seq_len, head_dim, base=10000.0, dtype=jnp.float32,
+                offset=0):
     inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
                           / head_dim))
-    t = jnp.arange(seq_len, dtype=jnp.float32)
+    t = offset + jnp.arange(seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv)  # [S, D/2]
     return jnp.sin(freqs).astype(dtype), jnp.cos(freqs).astype(dtype)
 
 
-def apply_rope(x, sin=None, cos=None, neox=True, base=10000.0):
-    """x: [B, S, H, D]."""
+def apply_rope(x, sin=None, cos=None, neox=True, base=10000.0, offset=0):
+    """x: [B, S, H, D].  `offset` shifts the absolute positions (KV-cached
+    decode: the query sits at position offset, not 0)."""
     b, s, h, d = x.shape
     if sin is None or cos is None:
-        sin, cos = rope_tables(s, d, base, jnp.float32)
+        sin, cos = rope_tables(s, d, base, jnp.float32, offset=offset)
     else:
         # paddle passes [1, S, 1, D] tables with duplicated halves
         sin = sin.reshape(s, -1)[:, : d // 2].astype(jnp.float32)

@@ -410,6 +410,167 @@ class GPTForCausalLM(Layer):
         return self._moe_aux
 
 
+    # -- generation (KV-cached decode) ---------------------------------------
+    def _cached_layers(self, c, lws, h, cache_k, cache_v, pos):
+        """Run all blocks on h [B, T, H] writing K/V into the caches at
+        positions [pos, pos+T) and attending to everything <= query pos.
+
+        cache_k/cache_v: [L, B, S, nh, hd].  This is the decode twin of the
+        training block (reference: masked_multihead_attention_kernel.cu /
+        fused_multi_transformer's CacheKV path) — one fused scan over
+        layers, dense O(S) attention against the cache, MXU-friendly
+        static shapes."""
+        nh = c.num_heads
+        eps = c.layer_norm_epsilon
+        B, T, H = h.shape
+        S = cache_k.shape[2]
+        hd = H // nh
+        scale = 1.0 / math.sqrt(hd)
+        kpos = jnp.arange(S)
+        qpos = pos + jnp.arange(T)
+        mask = kpos[None, :] <= qpos[:, None]          # [T, S]
+
+        def body(hh, xs):
+            lw, ck, cv = xs
+            x = _norm(hh, lw["ln1_w"], lw["ln1_b"], eps)
+            qkv = jnp.matmul(x, lw["qkv_w"], precision=matmul_precision()) \
+                + lw["qkv_b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, T, nh, hd)
+            k = k.reshape(B, T, nh, hd)
+            v = v.reshape(B, T, nh, hd)
+            if c.use_rope:
+                from ..kernels.rope import apply_rope
+                q = apply_rope(q, offset=pos)
+                k = apply_rope(k, offset=pos)
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                              (0, pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                              (0, pos, 0, 0))
+            logits = jnp.einsum("bqhd,bkhd->bhqk",
+                                (q * scale).astype(jnp.float32),
+                                ck.astype(jnp.float32))
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            p = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cv.dtype), cv)
+            o = o.reshape(B, T, H)
+            a = jnp.matmul(o, lw["proj_w"], precision=matmul_precision()) \
+                + lw["proj_b"]
+            hh = hh + a
+            x = _norm(hh, lw["ln2_w"], lw["ln2_b"], eps)
+            if c.num_experts > 0:
+                from ..incubate.moe import moe_ffn
+                f, _aux = moe_ffn(
+                    x, lw["gate_w"], lw["fc1_w"], lw["fc1_b"],
+                    lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
+                    capacity_factor=c.moe_capacity_factor)
+            else:
+                up = jnp.matmul(x, lw["fc1_w"],
+                                precision=matmul_precision()) + lw["fc1_b"]
+                f = jnp.matmul(jax.nn.gelu(up), lw["fc2_w"],
+                               precision=matmul_precision()) + lw["fc2_b"]
+            return hh + f, (ck, cv)
+
+        h, (cache_k, cache_v) = jax.lax.scan(body, h,
+                                             (lws, cache_k, cache_v))
+        return h, cache_k, cache_v
+
+    def _embed(self, c, wte, wpe, ids, pos):
+        h = jnp.take(wte, ids, axis=0)
+        if wpe is not None:
+            h = h + jax.lax.dynamic_slice_in_dim(wpe, pos, ids.shape[1],
+                                                 axis=0)
+        return h
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, eos_token_id=None, seed=None):
+        """Autoregressive decoding with a static KV cache, fully compiled
+        (prefill + lax.scan decode loop in ONE XLA program).
+
+        Reference analogue: the fused decode path
+        (masked_multihead_attention_kernel.cu + paddlenlp generate);
+        TPU-native: static cache shapes, dynamic_update_slice writes,
+        whole loop under jit.  Returns [B, T + max_new_tokens] token ids
+        (after eos, the row keeps emitting eos)."""
+        c = self.config
+        names = self._stacked()
+        lws = {n: getattr(self, n)._data for n in names}
+        wte = self.wte._data
+        wpe = self.wpe._data if not c.use_rope else None
+        head = (None if c.tie_word_embeddings else self.lm_head._data)
+        lnf_w, lnf_b = self.lnf_w._data, self.lnf_b._data
+        ids = input_ids._data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        B, T = ids.shape
+        S = T + int(max_new_tokens)
+        if S > c.max_seq_len and not c.use_rope:
+            raise ValueError(f"generation length {S} exceeds max_seq_len "
+                             f"{c.max_seq_len}")
+        from ..tensor.random import _DEFAULT_GEN
+        key = (jax.random.key(seed) if seed is not None
+               else _DEFAULT_GEN.next_key())
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+
+        def logits_of(h_last):
+            h_last = _norm(h_last, lnf_w, lnf_b, c.layer_norm_epsilon)
+            w = wte.T if c.tie_word_embeddings else head
+            return jnp.matmul(h_last, w,
+                              precision=matmul_precision()).astype(
+                                  jnp.float32)
+
+        def sample(lg, k):
+            if not do_sample:
+                return jnp.argmax(lg, axis=-1).astype(ids.dtype)
+            lg = lg / jnp.maximum(temperature, 1e-6)
+            if top_k and top_k > 0:
+                kth = jnp.sort(lg, axis=-1)[..., -int(top_k)][..., None]
+                lg = jnp.where(lg < kth, -1e30, lg)
+            return jax.random.categorical(k, lg, axis=-1).astype(ids.dtype)
+
+        def run(lws, wte, wpe, lnf_w, lnf_b, head, ids, key):
+            nh, H = c.num_heads, c.hidden_size
+            hd = H // nh
+            dt = jnp.dtype(c.dtype)
+            ck0 = jnp.zeros((c.num_layers, B, S, nh, hd), dt)
+            cv0 = jnp.zeros((c.num_layers, B, S, nh, hd), dt)
+            h = self._embed(c, wte, wpe, ids, 0)
+            h, ck, cv = self._cached_layers(c, lws, h, ck0, cv0, 0)
+            key, k0 = jax.random.split(key)
+            tok = sample(logits_of(h[:, -1]), k0)
+            done = (tok == eos)
+
+            def step(carry, i):
+                tok, ck, cv, done, key = carry
+                pos = T + i
+                h = self._embed(c, wte, wpe, tok[:, None], pos)
+                h, ck, cv = self._cached_layers(c, lws, h, ck, cv, pos)
+                key, ks = jax.random.split(key)
+                nxt = sample(logits_of(h[:, -1]), ks)
+                nxt = jnp.where(done, jnp.asarray(eos, ids.dtype), nxt)
+                done = done | (nxt == eos)
+                return (nxt, ck, cv, done, key), tok
+
+            (last, _, _, _, _), toks = jax.lax.scan(
+                step, (tok, ck, cv, done, key),
+                jnp.arange(max_new_tokens - 1))
+            new = jnp.concatenate(
+                [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+            return jnp.concatenate([ids, new], axis=1)
+
+        # sampling params only affect the trace when do_sample is on
+        cache_key = (B, T, int(max_new_tokens), eos,
+                     (bool(do_sample), float(temperature), int(top_k))
+                     if do_sample else False)
+        jits = getattr(self, "_gen_cache", None)
+        if jits is None:
+            jits = self._gen_cache = {}
+        if cache_key not in jits:
+            if len(jits) >= 16:  # bound retained executables (FIFO evict)
+                jits.pop(next(iter(jits)))
+            jits[cache_key] = jax.jit(run)
+        out = jits[cache_key](lws, wte, wpe, lnf_w, lnf_b, head, ids, key)
+        return Tensor._wrap(out)
+
     # -- 1F1B pipeline decomposition ----------------------------------------
     def pipeline_parts(self, tp_axis=None):
         """Split the model for the compiled 1F1B schedule
