@@ -47,6 +47,7 @@ from . import device  # noqa: F401
 from . import distributed  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import framework  # noqa: F401
 from . import geometric  # noqa: F401
 from . import hapi  # noqa: F401

@@ -65,10 +65,15 @@ class ElasticAgent:
         self.poll_interval = poll_interval
         self.restart_count = 0
         self.events = []  # (wallclock, kind, detail) — observability
+        self.bad_devices = set()  # excluded after repeated same-rank failure
+
+    def _device_pool(self):
+        return [d for d in range(self.nproc) if d not in self.bad_devices]
 
     # -- one incarnation -----------------------------------------------------
     def _spawn(self, nproc):
         os.makedirs(self.log_dir, exist_ok=True)
+        pool = self._device_pool()
         procs = []
         for rank in range(nproc):
             env = dict(self.base_env)
@@ -82,7 +87,9 @@ class ElasticAgent:
                 "PADDLE_TRAINERS_NUM": str(nproc),
                 "PADDLE_LOCAL_RANK": str(rank),
                 "PADDLE_RESTART_COUNT": str(self.restart_count),
-                "FLAGS_selected_tpus": str(rank),
+                # skip blacklisted devices: a shrunk world must not land
+                # back on the chip that killed it
+                "FLAGS_selected_tpus": str(pool[rank]),
                 _HEARTBEAT_ENV: hb,
             })
             if self.master:
@@ -165,10 +172,14 @@ class ElasticAgent:
                                     f"after {self.restart_count} restarts"))
                 return 1
             # the SAME rank failing twice in a row looks like a bad/lost
-            # resource, not a transient fault → shrink if allowed
+            # resource, not a transient fault → blacklist its device and
+            # shrink if allowed
             if (failed_rank is not None and failed_rank == last_failed_rank
                     and nproc > self.min_nproc):
+                bad_dev = self._device_pool()[failed_rank]
+                self.bad_devices.add(bad_dev)
                 nproc -= 1
-                self.events.append((time.time(), "shrink", f"nproc={nproc}"))
+                self.events.append((time.time(), "shrink",
+                                    f"nproc={nproc} excluded_dev={bad_dev}"))
             last_failed_rank = failed_rank
             self.restart_count += 1

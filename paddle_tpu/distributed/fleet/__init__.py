@@ -17,6 +17,9 @@ from .topology import CommunicateTopology, HybridCommunicateGroup
 from . import mp_layers as meta_parallel_mp  # noqa: F401
 from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
                         RowParallelLinear, VocabParallelEmbedding,
+                        ColumnSequenceParallelLinear,
+                        RowSequenceParallelLinear, GatherOp, ScatterOp,
+                        mark_as_sequence_parallel_parameter,
                         get_rng_state_tracker)
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401

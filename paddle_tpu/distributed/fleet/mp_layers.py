@@ -182,6 +182,115 @@ class ParallelCrossEntropy(Layer):
                                ignore_index=self.ignore_index)
 
 
+def mark_as_sequence_parallel_parameter(param):
+    """Tag a parameter whose gradient needs the sequence-parallel allreduce
+    (reference: sequence_parallel_utils.py mark_as_sequence_parallel_
+    parameter) — under GSPMD the grad sync is sharding-derived, so the tag
+    is metadata only."""
+    param.sequence_parallel = True
+    return param
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Megatron sequence-parallel column linear (reference:
+    fleet/utils/sequence_parallel_utils.py:427 — all-gather the
+    sequence-sharded input over mp, then the column-parallel matmul).
+
+    TPU-native: the input carries P(dp, 'mp', None) (sequence axis sharded
+    over the TP group — Megatron-SP reuses the mp ranks for sequence
+    sharding); the weight is column-sharded P(None, 'mp').  GSPMD lowers
+    the contraction to exactly the reference's all-gather + local matmul,
+    and the backward to the matching reduce-scatter."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.weight = param_attr_init((in_features, out_features),
+                                      self._dtype, weight_attr, False,
+                                      XavierUniform())
+        annotate_param(self.weight, P(None, "mp"))
+        if has_bias:
+            self.bias = param_attr_init((out_features,), self._dtype, None,
+                                        True, Constant(0.0))
+            annotate_param(self.bias, P("mp"))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        # input: [b, s/mp, h] sequence-sharded over the TP group
+        x = shard_constraint(x, P(("dp", "sharding"), "mp", None))
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return shard_constraint(out, P(("dp", "sharding"), None, None))
+        # sequence gathered, features sharded (ready for the row linear)
+        return shard_constraint(out, P(("dp", "sharding"), None, "mp"))
+
+
+class RowSequenceParallelLinear(Layer):
+    """Megatron sequence-parallel row linear (reference:
+    sequence_parallel_utils.py:562 — row-parallel matmul whose partial
+    sums REDUCE-SCATTER onto the sequence axis instead of all-reducing).
+
+    TPU-native: weight row-sharded P('mp', None); constraining the output
+    to P(dp, 'mp', None) makes GSPMD emit the reduce-scatter over 'mp'
+    (half the bytes of the RowParallelLinear all-reduce — the whole point
+    of Megatron SP)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = param_attr_init((in_features, out_features),
+                                      self._dtype, weight_attr, False,
+                                      XavierUniform())
+        annotate_param(self.weight, P("mp", None))
+        if has_bias:
+            self.bias = param_attr_init((out_features,), self._dtype, None,
+                                        True, Constant(0.0))
+            annotate_param(self.bias, P())
+            mark_as_sequence_parallel_parameter(self.bias)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = shard_constraint(x, P(("dp", "sharding"), None, "mp"))
+        out = F.linear(x, self.weight, self.bias)
+        # output sequence-sharded over mp: GSPMD inserts reduce-scatter
+        return shard_constraint(out, P(("dp", "sharding"), "mp", None))
+
+
+class GatherOp(Layer):
+    """all-gather along the sequence axis (reference:
+    sequence_parallel_utils.py GatherOp) — a resharding constraint here."""
+
+    @staticmethod
+    def apply(x):
+        return shard_constraint(x, P(("dp", "sharding"), None, None))
+
+    def forward(self, x):
+        return self.apply(x)
+
+
+class ScatterOp(Layer):
+    """split along the sequence axis over mp (reference:
+    sequence_parallel_utils.py ScatterOp)."""
+
+    @staticmethod
+    def apply(x):
+        return shard_constraint(x, P(("dp", "sharding"), "mp", None))
+
+    def forward(self, x):
+        return self.apply(x)
+
+
 # mp_ops-style helpers (reference: fleet/layers/mpu/mp_ops.py)
 def _c_identity(tensor, group=None):
     return tensor

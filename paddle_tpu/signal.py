@@ -1,0 +1,171 @@
+"""paddle.signal — frame / overlap_add / stft / istft.
+
+Reference: /root/reference/python/paddle/signal.py (frame:30, overlap_add
+:131, stft:193, istft:368 — thin wrappers over fft + framing kernels).
+TPU-native: pure jnp gather/scatter + jnp.fft; XLA fuses the framing with
+the FFT's data movement.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op
+from .core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames (reference layouts, signal.py:30):
+    axis=-1: [..., N]  -> [..., frame_length, n_frames]
+    axis=0:  [N, ...]  -> [n_frames, frame_length, ...]"""
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1 (reference contract)")
+
+    def fn(v):
+        ax = 0 if axis == 0 else v.ndim - 1
+        n = v.shape[ax]
+        if frame_length > n:
+            raise ValueError(
+                f"frame_length {frame_length} > signal length {n}")
+        n_frames = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        if axis == 0:
+            idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+            out = jnp.take(v, idx.reshape(-1), axis=0)
+            return out.reshape((n_frames, frame_length) + v.shape[1:])
+        idx = starts[None, :] + jnp.arange(frame_length)[:, None]
+        out = jnp.take(v, idx.reshape(-1), axis=ax)
+        return out.reshape(v.shape[:-1] + (frame_length, n_frames))
+
+    return apply_op("frame", fn, _t(x))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference layouts, signal.py:131):
+    axis=-1: [..., frame_length, n_frames] -> [..., N]
+    axis=0:  [n_frames, frame_length, ...] -> [N, ...]"""
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1 (reference contract)")
+
+    def fn(v):
+        if axis == 0:
+            # [nf, fl, ...] -> [..., fl, nf]
+            v2 = jnp.moveaxis(v, (0, 1), (-1, -2))
+        else:
+            v2 = v
+        fl, nf = v2.shape[-2], v2.shape[-1]
+        n = (nf - 1) * hop_length + fl
+        lead = v2.shape[:-2]
+        flat = v2.reshape(-1, fl, nf)
+        idx = (jnp.arange(nf)[None, :] * hop_length
+               + jnp.arange(fl)[:, None])           # [fl, nf]
+
+        def one(sig):
+            return jnp.zeros((n,), v.dtype).at[idx.reshape(-1)].add(
+                sig.reshape(-1))
+
+        out = jax.vmap(one)(flat).reshape(*lead, n)
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return apply_op("overlap_add", fn, _t(x))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference: signal.py:193).
+
+    x: [..., N] real (or complex with onesided=False).
+    Returns [..., n_fft//2+1 (or n_fft), n_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = _t(window)
+
+    def fn(v, *w):
+        win = w[0] if w else jnp.ones((win_length,), jnp.float32)
+        if win_length < n_fft:  # center-pad the window to n_fft
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        if center:
+            pad = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pad, mode=pad_mode)
+        n = v.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]   # [nf, n_fft]
+        frames = v[..., idx] * win                           # [..., nf, n_fft]
+        if onesided and not jnp.iscomplexobj(v):
+            spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, n=n_fft, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.swapaxes(spec, -1, -2)    # [..., freq, n_frames]
+
+    args = [_t(x)] + ([window] if window is not None else [])
+    return apply_op("stft", fn, *args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with NOLA window-envelope normalization
+    (reference: signal.py:368)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = _t(window)
+
+    def fn(v, *w):
+        win = w[0] if w else jnp.ones((win_length,), jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        spec = jnp.swapaxes(v, -1, -2)       # [..., n_frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, n=n_fft, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * win
+        nf = frames.shape[-2]
+        n = (nf - 1) * hop_length + n_fft
+        lead = frames.shape[:-2]
+        flat = frames.reshape(-1, nf, n_fft)
+
+        idx = (jnp.arange(nf)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+
+        def one(fr):
+            return jnp.zeros((n,), fr.dtype).at[idx.reshape(-1)].add(
+                fr.reshape(-1))
+
+        out = jax.vmap(one)(flat)
+        env = jnp.zeros((n,), jnp.float32).at[idx.reshape(-1)].add(
+            jnp.tile((win.astype(jnp.float32) ** 2)[None], (nf, 1))
+            .reshape(-1))
+        out = out / jnp.maximum(env, 1e-11)
+        out = out.reshape(*lead, n)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    args = [_t(x)] + ([window] if window is not None else [])
+    return apply_op("istft", fn, *args)

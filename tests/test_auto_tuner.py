@@ -1,0 +1,83 @@
+"""Auto-tuner: parallel-config search (reference:
+distributed/auto_tuner/tuner.py:21, search.py:31-144, prune.py)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import AutoTuner, TuneSpace, tune
+
+
+def gpt_1_3b(n_devices=8, global_batch=64, hbm=15.75e9):
+    return TuneSpace(n_devices=n_devices, num_layers=24, hidden_size=2048,
+                     num_heads=16, vocab_size=50304, seq_len=1024,
+                     global_batch=global_batch, hbm_bytes=hbm)
+
+
+class TestPruning:
+    def test_divisibility_rules(self):
+        t = AutoTuner(gpt_1_3b())
+        from paddle_tpu.distributed.auto_tuner import Candidate
+        assert "num_layers" in t.prune_reason(Candidate(1, 1, 5, 1, 8))
+        assert "num_heads" in t.prune_reason(
+            Candidate(1, 32, 1, 1, 8, 0, 0))
+        assert "global_batch" in t.prune_reason(Candidate(8, 1, 1, 1, 32))
+        assert "mb" in t.prune_reason(Candidate(1, 1, 8, 1, 4))
+
+    def test_memory_prunes_single_chip_1_3b(self):
+        """1.3B with AdamW state cannot sit on one chip (scripts/
+        PERF_NOTES.md) — the dp8 pure-data-parallel candidate must be
+        memory-pruned."""
+        t = AutoTuner(gpt_1_3b())
+        from paddle_tpu.distributed.auto_tuner import Candidate
+        reason = t.prune_reason(Candidate(8, 1, 1, 1, 8))
+        assert reason is not None and "HBM" in reason, reason
+
+    def test_all_pruned_raises_with_reasons(self):
+        space = gpt_1_3b(n_devices=1, hbm=1e9)  # nothing fits 1G
+        with pytest.raises(ValueError, match="every candidate pruned"):
+            AutoTuner(space).tune()
+
+
+class TestSearch:
+    def test_finds_model_parallel_config_for_1_3b(self):
+        """On 8 chips the tuner must pick a config that actually shards the
+        1.3B state (mp, pp, or sharding > 1) and fits HBM."""
+        best = AutoTuner(gpt_1_3b()).tune()
+        assert best.mp * best.pp * best.sharding > 1, best
+        assert best.est_hbm <= 15.75e9
+        assert best.dp * best.mp * best.pp * best.sharding == 8
+
+    def test_small_model_prefers_pure_dp(self):
+        """A 125M model fits everywhere; pure data parallel has zero TP/PP
+        comm and must win the analytic ranking."""
+        space = TuneSpace(n_devices=8, num_layers=12, hidden_size=768,
+                          num_heads=12, vocab_size=50304, seq_len=1024,
+                          global_batch=64)
+        best = AutoTuner(space).tune()
+        assert best.mp == 1 and best.pp == 1, best
+
+    def test_trial_fn_overrides_ranking(self):
+        t = AutoTuner(gpt_1_3b())
+        calls = []
+
+        def trial(c):
+            calls.append(c)
+            # pretend the LAST tried candidate is fastest
+            return 1.0 / (len(calls))
+
+        best = t.tune(trial_fn=trial, top_n=3)
+        assert best.measured is not None
+        assert best is calls[-1]
+        assert len(calls) == 3
+
+    def test_trial_failures_fall_back(self):
+        t = AutoTuner(gpt_1_3b())
+        best = t.tune(trial_fn=lambda c: (_ for _ in ()).throw(
+            RuntimeError("oom")), top_n=2)
+        assert best is not None  # analytic winner survives
+
+    def test_convenience_entry(self):
+        best = tune(n_devices=8, num_layers=24, hidden_size=2048,
+                    num_heads=16, vocab_size=50304, seq_len=1024,
+                    global_batch=64)
+        assert best.dp * best.mp * best.pp * best.sharding == 8

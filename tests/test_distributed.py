@@ -222,6 +222,39 @@ class TestPipeline:
         assert out.shape == [2, 4]
 
 
+class TestSequenceParallelLinears:
+    def test_sp_pair_matches_dense(self, mesh8):
+        """ColumnSequenceParallelLinear -> RowSequenceParallelLinear ==
+        dense matmul chain (reference: sequence_parallel_utils.py:427,562 —
+        the SP pair is numerically the TP pair, only the collective moves
+        from all-reduce to all-gather/reduce-scatter)."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.fleet import (
+            ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+            ScatterOp, GatherOp)
+
+        paddle.seed(3)
+        col = ColumnSequenceParallelLinear(8, 16, has_bias=True)
+        row = RowSequenceParallelLinear(16, 8, has_bias=True)
+        x = paddle.randn([4, 8, 8])  # [b, s, h]
+
+        y = GatherOp.apply(row(paddle.nn.functional.gelu(
+            col(ScatterOp.apply(x)))))
+
+        w1, b1 = np.asarray(col.weight.numpy()), np.asarray(
+            col.bias.numpy())
+        w2, b2 = np.asarray(row.weight.numpy()), np.asarray(
+            row.bias.numpy())
+        xn = np.asarray(x.numpy())
+        hidden = xn @ w1 + b1
+        gelu = 0.5 * hidden * (1 + np.vectorize(__import__("math").erf)(
+            hidden / np.sqrt(2)))
+        ref = gelu @ w2 + b2
+        assert np.allclose(np.asarray(y.numpy()), ref, atol=1e-4), \
+            np.abs(np.asarray(y.numpy()) - ref).max()
+
+
 class TestGPTHybrid:
     def test_gpt_dist_train(self, mesh8):
         from paddle_tpu.distributed import DistributedTrainStep

@@ -1,0 +1,92 @@
+"""paddle.signal: frame/overlap_add/stft/istft (reference:
+python/paddle/signal.py; parity vs scipy-style numpy references)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestFrame:
+    def test_frame_and_inverse(self):
+        x = np.arange(16, dtype=np.float32)
+        f = paddle.signal.frame(paddle.to_tensor(x), 4, 2)
+        fn = np.asarray(f.numpy())
+        assert fn.shape == (4, 7)
+        for j in range(7):
+            assert np.array_equal(fn[:, j], x[j * 2: j * 2 + 4])
+        # overlap_add of ones-framed == windowed-count * x pattern
+        back = paddle.signal.overlap_add(f, 2)
+        exp = np.zeros(16, np.float32)
+        for j in range(7):
+            exp[j * 2: j * 2 + 4] += x[j * 2: j * 2 + 4]
+        assert np.allclose(np.asarray(back.numpy()), exp)
+
+    def test_batched(self):
+        x = np.random.RandomState(0).randn(3, 20).astype(np.float32)
+        f = paddle.signal.frame(paddle.to_tensor(x), 5, 3)
+        assert list(f.shape) == [3, 5, 6]
+
+    def test_axis0_reference_layout(self):
+        """axis=0: [N, ...] -> [n_frames, frame_length, ...] (the reference
+        docstring example, signal.py:30)."""
+        x = np.arange(8, dtype=np.float32)
+        f = np.asarray(paddle.signal.frame(
+            paddle.to_tensor(x), 4, 2, axis=0).numpy())
+        assert f.shape == (3, 4)
+        assert np.array_equal(f, [[0, 1, 2, 3], [2, 3, 4, 5], [4, 5, 6, 7]])
+        back = paddle.signal.overlap_add(
+            paddle.to_tensor(f), 2, axis=0)
+        exp = np.zeros(8, np.float32)
+        for j in range(3):
+            exp[j * 2: j * 2 + 4] += f[j]
+        assert np.allclose(np.asarray(back.numpy()), exp)
+
+    def test_overlap_add_axis0_batched(self):
+        """axis=0 with trailing dims: [nf, fl, d1, d2] -> [N, d1, d2]
+        (the reference overlap_add docstring example shape)."""
+        x = np.arange(32, dtype=np.float32).reshape(2, 8, 1, 2)
+        out = np.asarray(paddle.signal.overlap_add(
+            paddle.to_tensor(x), 2, axis=0).numpy())
+        assert out.shape == (10, 1, 2), out.shape
+        exp = np.zeros((10, 1, 2), np.float32)
+        for j in range(2):
+            exp[j * 2: j * 2 + 8] += x[j]
+        assert np.allclose(out, exp)
+
+
+class TestStft:
+    @pytest.mark.parametrize("center", [True, False])
+    def test_stft_matches_numpy(self, center):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 64).astype(np.float32)
+        n_fft, hop = 16, 4
+        win = np.hanning(n_fft).astype(np.float32)
+        out = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop,
+                                 window=paddle.to_tensor(win),
+                                 center=center)
+        got = np.asarray(out.numpy())
+        xr = x
+        if center:
+            xr = np.pad(x, [(0, 0), (n_fft // 2, n_fft // 2)],
+                        mode="reflect")
+        n_frames = 1 + (xr.shape[-1] - n_fft) // hop
+        assert got.shape == (2, n_fft // 2 + 1, n_frames)
+        for b in range(2):
+            for j in range(n_frames):
+                seg = xr[b, j * hop: j * hop + n_fft] * win
+                ref = np.fft.rfft(seg)
+                assert np.allclose(got[b, :, j], ref, atol=1e-4), (b, j)
+
+    def test_istft_roundtrip(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(64).astype(np.float32)
+        n_fft, hop = 16, 4
+        win = np.hanning(n_fft).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop,
+                                  window=paddle.to_tensor(win))
+        back = paddle.signal.istft(spec, n_fft, hop,
+                                   window=paddle.to_tensor(win),
+                                   length=64)
+        assert np.allclose(np.asarray(back.numpy()), x, atol=1e-4), \
+            np.abs(np.asarray(back.numpy()) - x).max()
