@@ -1,0 +1,98 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py —
+dense blocks with channel-concatenated feature reuse)."""
+
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
+                   Linear, MaxPool2D, ReLU, Sequential)
+from ...nn.layer.layers import Layer
+
+_CONFIGS = {
+    121: (6, 12, 24, 16),
+    161: (6, 12, 36, 24),
+    169: (6, 12, 32, 32),
+    201: (6, 12, 48, 32),
+    264: (6, 12, 64, 48),
+}
+
+
+class _DenseLayer(Layer):
+    """BN-ReLU-1x1 (bottleneck) + BN-ReLU-3x3 (+dropout), concatenated."""
+
+    def __init__(self, in_c, growth, bn_size, dropout=0.0):
+        super().__init__()
+        mid = bn_size * growth
+        layers = [BatchNorm2D(in_c), ReLU(),
+                  Conv2D(in_c, mid, 1, bias_attr=False),
+                  BatchNorm2D(mid), ReLU(),
+                  Conv2D(mid, growth, 3, padding=1, bias_attr=False)]
+        if dropout > 0:
+            from ...nn import Dropout
+            layers.append(Dropout(dropout))
+        self.fn = Sequential(*layers)
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+        return concat([x, self.fn(x)], axis=1)
+
+
+class _Transition(Sequential):
+    def __init__(self, in_c, out_c):
+        super().__init__(BatchNorm2D(in_c), ReLU(),
+                         Conv2D(in_c, out_c, 1, bias_attr=False),
+                         AvgPool2D(2, stride=2))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True, growth_rate=None):
+        super().__init__()
+        if layers not in _CONFIGS:
+            raise ValueError(f"layers must be one of {list(_CONFIGS)}")
+        growth = growth_rate or (48 if layers == 161 else 32)
+        init_c = 2 * growth
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(init_c), ReLU(),
+            MaxPool2D(3, stride=2, padding=1))
+        blocks = []
+        ch = init_c
+        cfg = _CONFIGS[layers]
+        for bi, n in enumerate(cfg):
+            for _ in range(n):
+                blocks.append(_DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if bi != len(cfg) - 1:
+                blocks.append(_Transition(ch, ch // 2))
+                ch //= 2
+        blocks += [BatchNorm2D(ch), ReLU()]
+        self.blocks = Sequential(*blocks)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def _factory(layers):
+    def build(pretrained=False, **kwargs):
+        if pretrained:
+            raise RuntimeError(
+                "pretrained weights unavailable (zero egress)")
+        return DenseNet(layers=layers, **kwargs)
+    return build
+
+
+densenet121 = _factory(121)
+densenet161 = _factory(161)
+densenet169 = _factory(169)
+densenet201 = _factory(201)
+densenet264 = _factory(264)

@@ -1,0 +1,71 @@
+"""GoogLeNet / Inception v1 (reference:
+python/paddle/vision/models/googlenet.py — Inception modules with
+parallel 1x1/3x3/5x5/pool branches)."""
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Linear,
+                   MaxPool2D, ReLU, Sequential)
+from ...nn.layer.layers import Layer
+
+
+def _cbr(in_c, out_c, kernel, stride=1, padding=0):
+    return Sequential(Conv2D(in_c, out_c, kernel, stride, padding,
+                             bias_attr=False),
+                      BatchNorm2D(out_c), ReLU())
+
+
+class _Inception(Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _cbr(in_c, c1, 1)
+        self.b3 = Sequential(_cbr(in_c, c3r, 1), _cbr(c3r, c3, 3, padding=1))
+        self.b5 = Sequential(_cbr(in_c, c5r, 1), _cbr(c5r, c5, 5, padding=2))
+        self.bp = Sequential(MaxPool2D(3, stride=1, padding=1),
+                             _cbr(in_c, proj, 1))
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+        return concat([self.b1(x), self.b3(x), self.b5(x), self.bp(x)],
+                      axis=1)
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _cbr(3, 64, 7, stride=2, padding=3),
+            MaxPool2D(3, stride=2, padding=1),
+            _cbr(64, 64, 1), _cbr(64, 192, 3, padding=1),
+            MaxPool2D(3, stride=2, padding=1))
+        self.blocks = Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),      # 3a -> 256
+            _Inception(256, 128, 128, 192, 32, 96, 64),    # 3b -> 480
+            MaxPool2D(3, stride=2, padding=1),
+            _Inception(480, 192, 96, 208, 16, 48, 64),     # 4a -> 512
+            _Inception(512, 160, 112, 224, 24, 64, 64),    # 4b
+            _Inception(512, 128, 128, 256, 24, 64, 64),    # 4c
+            _Inception(512, 112, 144, 288, 32, 64, 64),    # 4d -> 528
+            _Inception(528, 256, 160, 320, 32, 128, 128),  # 4e -> 832
+            MaxPool2D(3, stride=2, padding=1),
+            _Inception(832, 256, 160, 320, 32, 128, 128),  # 5a
+            _Inception(832, 384, 192, 384, 48, 128, 128))  # 5b -> 1024
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.head = Sequential(Dropout(0.2), Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+            x = self.head(flatten(x, 1))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (zero egress)")
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,138 @@
+"""Inception v3 (reference: python/paddle/vision/models/inceptionv3.py —
+factorized 7x1/1x7 convolutions and expanded filter-bank modules)."""
+
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
+                   Dropout, Linear, MaxPool2D, ReLU, Sequential)
+from ...nn.layer.layers import Layer
+
+
+def _cbr(in_c, out_c, kernel, stride=1, padding=0):
+    return Sequential(Conv2D(in_c, out_c, kernel, stride, padding,
+                             bias_attr=False),
+                      BatchNorm2D(out_c), ReLU())
+
+
+def _cat(xs):
+    from ...tensor.manipulation import concat
+    return concat(xs, axis=1)
+
+
+class _InceptionA(Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _cbr(in_c, 64, 1)
+        self.b5 = Sequential(_cbr(in_c, 48, 1), _cbr(48, 64, 5, padding=2))
+        self.b3 = Sequential(_cbr(in_c, 64, 1), _cbr(64, 96, 3, padding=1),
+                             _cbr(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             _cbr(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)])
+
+
+class _ReductionA(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _cbr(in_c, 384, 3, stride=2)
+        self.b3d = Sequential(_cbr(in_c, 64, 1), _cbr(64, 96, 3, padding=1),
+                              _cbr(96, 96, 3, stride=2))
+        self.bp = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b3d(x), self.bp(x)])
+
+
+class _InceptionB(Layer):
+    """Factorized 7x7: (1x7)(7x1) chains."""
+
+    def __init__(self, in_c, mid):
+        super().__init__()
+        self.b1 = _cbr(in_c, 192, 1)
+        self.b7 = Sequential(
+            _cbr(in_c, mid, 1), _cbr(mid, mid, (1, 7), padding=(0, 3)),
+            _cbr(mid, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(
+            _cbr(in_c, mid, 1), _cbr(mid, mid, (7, 1), padding=(3, 0)),
+            _cbr(mid, mid, (1, 7), padding=(0, 3)),
+            _cbr(mid, mid, (7, 1), padding=(3, 0)),
+            _cbr(mid, 192, (1, 7), padding=(0, 3)))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             _cbr(in_c, 192, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)])
+
+
+class _ReductionB(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = Sequential(_cbr(in_c, 192, 1), _cbr(192, 320, 3, stride=2))
+        self.b7 = Sequential(
+            _cbr(in_c, 192, 1), _cbr(192, 192, (1, 7), padding=(0, 3)),
+            _cbr(192, 192, (7, 1), padding=(3, 0)),
+            _cbr(192, 192, 3, stride=2))
+        self.bp = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b7(x), self.bp(x)])
+
+
+class _InceptionC(Layer):
+    """Expanded filter bank: 3x3 splits into parallel 1x3 + 3x1."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _cbr(in_c, 320, 1)
+        self.b3_stem = _cbr(in_c, 384, 1)
+        self.b3_a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.bd_stem = Sequential(_cbr(in_c, 448, 1),
+                                  _cbr(448, 384, 3, padding=1))
+        self.bd_a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.bd_b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             _cbr(in_c, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.bd_stem(x)
+        return _cat([self.b1(x), self.b3_a(s), self.b3_b(s),
+                     self.bd_a(d), self.bd_b(d), self.bp(x)])
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _cbr(3, 32, 3, stride=2), _cbr(32, 32, 3),
+            _cbr(32, 64, 3, padding=1), MaxPool2D(3, stride=2),
+            _cbr(64, 80, 1), _cbr(80, 192, 3), MaxPool2D(3, stride=2))
+        self.blocks = Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _ReductionA(288),
+            _InceptionB(768, 128), _InceptionB(768, 160),
+            _InceptionB(768, 160), _InceptionB(768, 192),
+            _ReductionB(768),
+            _InceptionC(1280), _InceptionC(2048))
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.head = Sequential(Dropout(0.2), Linear(2048, num_classes))
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+            x = self.head(flatten(x, 1))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (zero egress)")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,63 @@
+"""MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py —
+depthwise-separable conv stacks)."""
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Linear, ReLU,
+                   Sequential)
+from ...nn.layer.layers import Layer
+
+
+class _ConvBNRelu(Sequential):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1):
+        super().__init__(
+            Conv2D(in_c, out_c, kernel, stride, (kernel - 1) // 2,
+                   groups=groups, bias_attr=False),
+            BatchNorm2D(out_c), ReLU())
+
+
+class _DepthwiseSeparable(Sequential):
+    """3x3 depthwise + 1x1 pointwise, each with BN+ReLU."""
+
+    def __init__(self, in_c, out_c, stride):
+        super().__init__(
+            _ConvBNRelu(in_c, in_c, 3, stride, groups=in_c),
+            _ConvBNRelu(in_c, out_c, 1))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        # (out_channels, stride) after the stem
+        plan = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+                (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+                (1024, 2), (1024, 1)]
+        layers = [_ConvBNRelu(3, c(32), stride=2)]
+        in_c = c(32)
+        for out, s in plan:
+            layers.append(_DepthwiseSeparable(in_c, c(out), s))
+            in_c = c(out)
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (zero egress)")
+    return MobileNetV1(scale=scale, **kwargs)

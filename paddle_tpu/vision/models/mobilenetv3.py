@@ -1,0 +1,130 @@
+"""MobileNetV3 small/large (reference:
+python/paddle/vision/models/mobilenetv3.py — inverted residuals with
+squeeze-excite and hard-swish)."""
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout,
+                   Hardsigmoid, Hardswish, Linear, ReLU, Sequential)
+from ...nn.layer.layers import Layer
+from .mobilenetv2 import _make_divisible
+
+
+class _ConvBNAct(Sequential):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1, act=None):
+        layers = [Conv2D(in_c, out_c, kernel, stride, (kernel - 1) // 2,
+                         groups=groups, bias_attr=False),
+                  BatchNorm2D(out_c)]
+        if act == "relu":
+            layers.append(ReLU())
+        elif act == "hardswish":
+            layers.append(Hardswish())
+        super().__init__(*layers)
+
+
+class _SqueezeExcite(Layer):
+    def __init__(self, ch, reduction=4):
+        super().__init__()
+        mid = _make_divisible(ch // reduction)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(ch, mid, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(mid, ch, 1)
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvertedResidualV3(Layer):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp_c != in_c:
+            layers.append(_ConvBNAct(in_c, exp_c, 1, act=act))
+        layers.append(_ConvBNAct(exp_c, exp_c, kernel, stride,
+                                 groups=exp_c, act=act))
+        if use_se:
+            layers.append(_SqueezeExcite(exp_c))
+        layers.append(_ConvBNAct(exp_c, out_c, 1, act=None))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        return x + self.block(x) if self.use_res else self.block(x)
+
+
+# (kernel, expansion, out, use_se, act, stride)
+_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        layers = [_ConvBNAct(3, in_c, 3, stride=2, act="hardswish")]
+        for k, exp, out, se, act, s in config:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            layers.append(_InvertedResidualV3(in_c, exp_c, out_c, k, s,
+                                              se, act))
+            in_c = out_c
+        last_exp = _make_divisible(config[-1][1] * scale)
+        layers.append(_ConvBNAct(in_c, last_exp, 1, act="hardswish"))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(last_exp, last_channel), Hardswish(), Dropout(0.2),
+                Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (zero egress)")
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (zero egress)")
+    return MobileNetV3Small(scale=scale, **kwargs)

@@ -1,0 +1,130 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py —
+channel-split residual units with channel shuffle)."""
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Linear, MaxPool2D,
+                   ReLU, Sequential)
+from ...nn.layer.layers import Layer
+
+
+def _channel_shuffle(x, groups):
+    from ...tensor.manipulation import reshape, transpose
+    b, c, h, w = x.shape
+    x = reshape(x, [b, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+def _conv_bn(in_c, out_c, kernel, stride, groups=1, act="relu"):
+    from ...nn import Swish
+    layers = [Conv2D(in_c, out_c, kernel, stride, (kernel - 1) // 2,
+                     groups=groups, bias_attr=False), BatchNorm2D(out_c)]
+    if act == "relu":
+        layers.append(ReLU())
+    elif act == "swish":
+        layers.append(Swish())
+    elif act is not None and act is not False:
+        raise ValueError(f"unsupported activation {act!r}")
+    return Sequential(*layers)
+
+
+class _ShuffleUnit(Layer):
+    """stride-1 unit: split channels, transform one half, shuffle."""
+
+    def __init__(self, ch, act="relu"):
+        super().__init__()
+        half = ch // 2
+        self.branch = Sequential(
+            _conv_bn(half, half, 1, 1, act=act),
+            _conv_bn(half, half, 3, 1, groups=half, act=None),
+            _conv_bn(half, half, 1, 1, act=act))
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat, split
+        x1, x2 = split(x, 2, axis=1)
+        out = concat([x1, self.branch(x2)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class _ShuffleDownUnit(Layer):
+    """stride-2 unit: both branches transform, channels double."""
+
+    def __init__(self, in_c, out_c, act="relu"):
+        super().__init__()
+        half = out_c // 2
+        self.left = Sequential(
+            _conv_bn(in_c, in_c, 3, 2, groups=in_c, act=None),
+            _conv_bn(in_c, half, 1, 1, act=act))
+        self.right = Sequential(
+            _conv_bn(in_c, half, 1, 1, act=act),
+            _conv_bn(half, half, 3, 2, groups=half, act=None),
+            _conv_bn(half, half, 1, 1, act=act))
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+        out = concat([self.left(x), self.right(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_STAGE_CHANNELS = {
+    0.5: (48, 96, 192, 1024),
+    1.0: (116, 232, 464, 1024),
+    1.5: (176, 352, 704, 1024),
+    2.0: (244, 488, 976, 2048),
+}
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_CHANNELS:
+            raise ValueError(f"scale must be one of {list(_STAGE_CHANNELS)}")
+        c1, c2, c3, c_last = _STAGE_CHANNELS[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(_conv_bn(3, 24, 3, 2, act=act),
+                               MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        in_c = 24
+        for out_c, repeat in ((c1, 4), (c2, 8), (c3, 4)):
+            units = [_ShuffleDownUnit(in_c, out_c, act=act)]
+            units += [_ShuffleUnit(out_c, act=act)
+                      for _ in range(repeat - 1)]
+            stages.append(Sequential(*units))
+            in_c = out_c
+        self.stages = Sequential(*stages)
+        self.tail = _conv_bn(in_c, c_last, 1, 1, act=act)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(c_last, num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def _factory(scale):
+    def build(pretrained=False, **kwargs):
+        if pretrained:
+            raise RuntimeError(
+                "pretrained weights unavailable (zero egress)")
+        return ShuffleNetV2(scale=scale, **kwargs)
+    return build
+
+
+shufflenet_v2_x0_5 = _factory(0.5)
+shufflenet_v2_x1_0 = _factory(1.0)
+shufflenet_v2_x1_5 = _factory(1.5)
+shufflenet_v2_x2_0 = _factory(2.0)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (zero egress)")
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

@@ -93,6 +93,20 @@ class TestVisionModels:
         out = net(paddle.randn([1, 3, 32, 32]))
         assert out.shape == [1, 10]
 
+    @pytest.mark.parametrize("family", [
+        "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small",
+        "mobilenet_v3_large", "shufflenet_v2_x0_5", "densenet121",
+        "googlenet", "inception_v3"])
+    def test_all_families_forward(self, family):
+        """Every reference vision family (vision/models/) builds and runs
+        a forward at ImageNet-ish resolution."""
+        import paddle_tpu.vision.models as M
+        net = getattr(M, family)(num_classes=7)
+        net.eval()
+        size = 299 if family == "inception_v3" else 224
+        out = net(paddle.randn([1, 3, size, size]))
+        assert out.shape == [1, 7], family
+
 
 class TestGPTSingle:
     def test_forward_and_train(self):
