@@ -24,21 +24,14 @@ class Spectrogram(Layer):
         self.register_buffer("window", get_window(window, self.win_length))
 
     def forward(self, x):
-        def fn(v, w):
-            if self.center:
-                pad = self.n_fft // 2
-                v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
-                            mode="reflect" if self.pad_mode == "reflect"
-                            else "constant")
-            n_frames = 1 + (v.shape[-1] - self.n_fft) // self.hop
-            idx = (jnp.arange(self.n_fft)[None, :]
-                   + self.hop * jnp.arange(n_frames)[:, None])
-            frames = v[..., idx]  # [..., frames, n_fft]
-            wpad = jnp.pad(w, (0, self.n_fft - self.win_length))
-            spec = jnp.fft.rfft(frames * wpad, axis=-1)
-            mag = jnp.abs(spec) ** self.power
-            return jnp.swapaxes(mag, -1, -2)  # [..., freq, frames]
-        return apply_op("spectrogram", fn, x, self.window)
+        # built on paddle.signal.stft (reference layers.py does the same) —
+        # ONE framing+FFT implementation in the codebase
+        from ..signal import stft
+        spec = stft(x, self.n_fft, self.hop, self.win_length,
+                    window=self.window, center=self.center,
+                    pad_mode=self.pad_mode)
+        return apply_op("spectrogram",
+                        lambda s: jnp.abs(s) ** self.power, spec)
 
 
 class MelSpectrogram(Layer):

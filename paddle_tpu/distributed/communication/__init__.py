@@ -298,16 +298,38 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    if _nranks(group) <= 1:
+    """Eager point-to-point send (reference: communication/send.py).
+
+    Host-level implementation over the global allgather primitive, which
+    is collective over ALL processes — safe exactly when every process is
+    in a matched send/recv pair, i.e. world size 2.  Larger worlds must
+    use the compiled path (lax.ppermute in distributed/pipeline.py), where
+    p2p is a real neighbor exchange."""
+    n = _nranks(group)
+    if n <= 1:
         return _Task(tensor._data)
-    raise NotImplementedError("eager p2p send: compiled pipelines use "
-                              "lax.ppermute")
+    if get_world_size() > 2:
+        raise NotImplementedError(
+            "eager send/recv is supported for world size 2 (both processes "
+            "rendezvous); with more processes use the compiled pipeline "
+            "path (lax.ppermute) or batch the transfer as a collective")
+    from jax.experimental import multihost_utils
+    multihost_utils.process_allgather(tensor._data)  # rendezvous w/ recv
+    return _Task(tensor._data)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    if _nranks(group) <= 1:
+    """Eager point-to-point receive (see send)."""
+    n = _nranks(group)
+    if n <= 1:
         return _Task(tensor._data)
-    raise NotImplementedError
+    if get_world_size() > 2:
+        raise NotImplementedError(
+            "eager send/recv is supported for world size 2; see send()")
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(tensor._data)
+    tensor._data = jnp.asarray(gathered)[src]
+    return _Task(tensor._data)
 
 
 def isend(tensor, dst=0, group=None):

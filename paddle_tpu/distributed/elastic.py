@@ -181,5 +181,9 @@ class ElasticAgent:
                 nproc -= 1
                 self.events.append((time.time(), "shrink",
                                     f"nproc={nproc} excluded_dev={bad_dev}"))
+                # ranks remap after a shrink: a fresh double-failure is
+                # required before the next exclusion (otherwise one-off
+                # faults cascade-blacklist healthy devices)
+                failed_rank = None
             last_failed_rank = failed_rank
             self.restart_count += 1

@@ -189,6 +189,20 @@ class MoELayer(Layer):
         # replicate experts when they can't shard evenly over the axis
         self.ep_axis = ep_axis if (ep_axis and num_experts % deg == 0) \
             else None
+        if ep_axis and deg > 1 and self.ep_axis is None:
+            import warnings
+            warnings.warn(
+                f"MoELayer: num_experts={num_experts} does not divide the "
+                f"'{ep_axis}' axis degree {deg}; experts will be REPLICATED "
+                "(no expert parallelism). Choose num_experts as a multiple "
+                f"of {deg} for EP sharding.", RuntimeWarning, stacklevel=2)
+        if num_experts >= 64:
+            import warnings
+            warnings.warn(
+                f"MoELayer: the GShard dense one-hot dispatch materialises "
+                f"[tokens, E={num_experts}, capacity] tensors — memory "
+                "grows linearly in E; at E>=64 consider a sparser routing "
+                "formulation", RuntimeWarning, stacklevel=2)
         ep_axis = self.ep_axis
         init = Normal(0.0, 0.02)
         zeros = Constant(0.0)

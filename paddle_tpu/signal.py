@@ -94,6 +94,10 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
         window = _t(window)
 
     def fn(v, *w):
+        if jnp.iscomplexobj(v) and onesided:
+            raise ValueError(
+                "stft: onesided must be False for complex input "
+                "(reference signal.py contract)")
         win = w[0] if w else jnp.ones((win_length,), jnp.float32)
         if win_length < n_fft:  # center-pad the window to n_fft
             lp = (n_fft - win_length) // 2
@@ -102,6 +106,10 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
             pad = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
             v = jnp.pad(v, pad, mode=pad_mode)
         n = v.shape[-1]
+        if n < n_fft:
+            raise ValueError(
+                f"stft: signal length {n} < n_fft {n_fft} "
+                f"(center={center}); pad the input or enable center")
         n_frames = 1 + (n - n_fft) // hop_length
         starts = jnp.arange(n_frames) * hop_length
         idx = starts[:, None] + jnp.arange(n_fft)[None, :]   # [nf, n_fft]
@@ -129,6 +137,15 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         window = _t(window)
 
     def fn(v, *w):
+        expect = n_fft // 2 + 1 if onesided else n_fft
+        if v.shape[-2] != expect:
+            raise ValueError(
+                f"istft: spectrum has {v.shape[-2]} frequency bins, "
+                f"expected {expect} for n_fft={n_fft} onesided={onesided}")
+        if onesided and return_complex:
+            raise ValueError(
+                "istft: return_complex=True requires onesided=False "
+                "(a onesided inverse is real by construction)")
         win = w[0] if w else jnp.ones((win_length,), jnp.float32)
         if win_length < n_fft:
             lp = (n_fft - win_length) // 2
