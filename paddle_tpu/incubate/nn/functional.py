@@ -303,10 +303,72 @@ def fused_matmul_bias(x, y, bias=None, trans_x=False, trans_y=False,
                                    trans_x, trans_y, activation="none")
 
 
-def block_multihead_attention(*args, **kwargs):
-    raise NotImplementedError(
-        "paged-KV inference attention lands with the serving stack; "
-        "use scaled_dot_product_attention")
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
+                              block_tables, max_seq_len=None, rope_emb=None,
+                              mask=None, **kwargs):
+    """Paged-KV decode attention (vLLM-style block cache).
+
+    Reference: phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
+    — the KV cache lives in fixed-size pages; a per-sequence block table
+    maps logical positions to pages, so sequences of different lengths
+    share one pool without padding waste.
+
+    TPU-native contract (the CUDA kernel's quant/varlen plumbing is out of
+    scope and rejected via **kwargs):
+    qkv:         [B, 3*H] — fused qkv of ONE decode token per sequence.
+    key_cache /
+    value_cache: [num_pages, num_heads, page_size, head_dim] pools.
+    seq_lens:    [B] int — tokens already cached per sequence (the new
+                 token lands at this position).
+    block_tables:[B, max_pages_per_seq] int page ids (-1 = unassigned;
+                 the page for the write position must be assigned).
+    Returns (out [B, H], key_cache, value_cache) with the new K/V written.
+    """
+    if kwargs:
+        raise NotImplementedError(
+            f"block_multihead_attention: unsupported arguments "
+            f"{sorted(kwargs)} (int8/cachekv-quant and varlen prefill are "
+            "CUDA-kernel specific; the TPU path serves the paged decode "
+            "contract)")
+    if rope_emb is not None:
+        raise NotImplementedError(
+            "block_multihead_attention: rope_emb is not applied on the TPU "
+            "path — apply RoPE to qkv before the call "
+            "(kernels/rope.apply_rope with offset=seq_lens)")
+
+    def fn(xv, kc, vc, lens, tables, *extra):
+        B = xv.shape[0]
+        n_pages, nh, page, hd = kc.shape
+        max_pages = tables.shape[1]
+        q, k, v = xv.reshape(B, 3, nh, hd)[:, 0], \
+            xv.reshape(B, 3, nh, hd)[:, 1], xv.reshape(B, 3, nh, hd)[:, 2]
+        pos = lens.reshape(B).astype(jnp.int32)
+        page_of = tables[jnp.arange(B), pos // page]     # [B]
+        slot = pos % page
+        # scatter the new K/V into its page slot
+        kc = kc.at[page_of, :, slot].set(k.astype(kc.dtype))
+        vc = vc.at[page_of, :, slot].set(v.astype(vc.dtype))
+        # gather each sequence's pages -> contiguous [B, nh, S, hd]
+        safe_tables = jnp.maximum(tables, 0)             # [B, max_pages]
+        ck = kc[safe_tables]                             # [B, mp, nh, pg, hd]
+        cv = vc[safe_tables]
+        S = max_pages * page
+        ck = jnp.moveaxis(ck, 2, 1).reshape(B, nh, S, hd)
+        cv = jnp.moveaxis(cv, 2, 1).reshape(B, nh, S, hd)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        logits = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32) * scale,
+                            ck.astype(jnp.float32))
+        valid = jnp.arange(S)[None, :] <= pos[:, None]   # [B, S]
+        logits = jnp.where(valid[:, None, :], logits, -1e30)
+        if mask is not None:
+            logits = logits + extra[0].reshape(B, 1, -1)[..., :S]
+        p = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("bhs,bhsd->bhd", p.astype(cv.dtype), cv)
+        return o.reshape(B, nh * hd), kc, vc
+
+    extras = [mask] if mask is not None else []
+    return apply_op("block_multihead_attention", fn, qkv, key_cache,
+                    value_cache, seq_lens, block_tables, *extras)
 
 
 def variable_length_memory_efficient_attention(query, key, value, seq_lens,

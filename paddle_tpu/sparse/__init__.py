@@ -12,7 +12,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dispatch import apply_op
 from ..core.tensor import Tensor
+
+
+def _csr_rows(crows_np):
+    """Expand CSR row pointers to per-entry row indices."""
+    return np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
 
 
 class SparseCooTensor(Tensor):
@@ -44,8 +50,7 @@ class SparseCsrTensor(Tensor):
         self._cols = cols if isinstance(cols, Tensor) else Tensor(cols)
         self._values = values if isinstance(values, Tensor) else Tensor(values)
         self._dense_shape = tuple(int(s) for s in shape)
-        crows_np = np.asarray(self._crows._data)
-        rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+        rows = _csr_rows(np.asarray(self._crows._data))
         dense = jnp.zeros(self._dense_shape, self._values.dtype).at[
             rows, self._cols._data].add(self._values._data)
         super().__init__(dense, stop_gradient=stop_gradient)
@@ -84,27 +89,220 @@ def is_same_shape(x, y):
     return tuple(x.shape) == tuple(y.shape)
 
 
-# functional ops on "sparse" tensors operate on the dense backing
+def to_sparse_coo(x, sparse_dim=None):
+    """Dense -> COO (reference: Tensor.to_sparse_coo).  `sparse_dim` keeps
+    only the leading dims sparse (hybrid COO: values are [nnz, *dense
+    dims]).  Nonzero extraction is data-dependent — EAGER-only."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    sd = arr.ndim if sparse_dim is None else int(sparse_dim)
+    if not 0 < sd <= arr.ndim:
+        raise ValueError(f"sparse_dim must be in [1, {arr.ndim}]")
+    if sd == arr.ndim:
+        idx = np.stack(np.nonzero(arr))
+        vals = arr[tuple(idx)]
+        return SparseCooTensor(idx, vals, arr.shape)
+    flat = arr.reshape(arr.shape[:sd] + (-1,))
+    keep = np.nonzero(np.abs(flat).sum(-1))          # leading-dim support
+    idx = np.stack(keep)
+    vals = arr[keep]                                 # [nnz, *dense dims]
+    return SparseCooTensor(idx, vals, arr.shape)
+
+
+def to_sparse_csr(x):
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    if arr.ndim != 2:
+        raise ValueError("CSR requires a 2-D tensor")
+    rows, cols = np.nonzero(arr)
+    crows = np.zeros(arr.shape[0] + 1, np.int64)
+    np.add.at(crows[1:], rows, 1)
+    crows = np.cumsum(crows)
+    return SparseCsrTensor(crows, cols, arr[rows, cols], arr.shape)
+
+
+def _rebuild_like(x, new_values):
+    """Same sparsity pattern, new values (Tensor or raw array)."""
+    nv = new_values if isinstance(new_values, Tensor) \
+        else Tensor._wrap(new_values)
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x._indices, nv, x._dense_shape)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x._crows, x._cols, nv, x._dense_shape)
+    return nv
+
+
+def _unary(opname, jnp_fn):
+    """Zero-preserving unary op: applies to the stored values only
+    (reference sparse/unary.py pattern — f(0)=0, so the pattern holds).
+    Routed through apply_op so dense inputs keep autograd/AMP dispatch
+    and sparse values stay differentiable w.r.t. the values tensor."""
+    def op(x, name=None):
+        if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+            nv = apply_op(f"sparse_{opname}", jnp_fn, x._values)
+            return _rebuild_like(x, nv)
+        return apply_op(f"sparse_{opname}", jnp_fn, x)
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+tanh = _unary("tanh", jnp.tanh)
+square = _unary("square", jnp.square)
+sqrt = _unary("sqrt", jnp.sqrt)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+neg = _unary("neg", jnp.negative)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+relu6 = _unary("relu6", lambda v: jnp.clip(v, 0, 6))
+leaky_relu = _unary("leaky_relu", lambda v: jnp.where(v > 0, v, 0.01 * v))
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    fn = lambda v: jnp.power(v, factor)  # noqa: E731
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return _rebuild_like(x, apply_op("sparse_pow", fn, x._values))
+    return apply_op("sparse_pow", fn, x)
+
+
+def _cast_idx(t, index_dtype):
+    from ..core.dtype import convert_dtype
+    return Tensor._wrap(t._data.astype(convert_dtype(index_dtype)))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core.dtype import convert_dtype
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        vals = x._values
+        if value_dtype is not None:
+            vals = Tensor._wrap(
+                vals._data.astype(convert_dtype(value_dtype)))
+        if isinstance(x, SparseCooTensor):
+            idx = (_cast_idx(x._indices, index_dtype)
+                   if index_dtype is not None else x._indices)
+            return SparseCooTensor(idx, vals, x._dense_shape)
+        crows = (_cast_idx(x._crows, index_dtype)
+                 if index_dtype is not None else x._crows)
+        cols = (_cast_idx(x._cols, index_dtype)
+                if index_dtype is not None else x._cols)
+        return SparseCsrTensor(crows, cols, vals, x._dense_shape)
+    if value_dtype is not None:
+        return Tensor._wrap(x._data.astype(convert_dtype(value_dtype)))
+    return x
+
+
+def coalesce(x, name=None):
+    """Merge duplicate indices (the constructor already sums them —
+    rebuild from the dense backing for a canonical form)."""
+    return to_sparse_coo(x.to_dense())
+
+
+def nnz(x):
+    return int(x._values.shape[0])
+
+
+# binary / matmul family (dense backing: XLA:TPU has no sparse MXU path;
+# the capability surface is what matters — reference sparse/binary.py)
 def matmul(x, y, name=None):
     from ..tensor.math import matmul as mm
-    return mm(x, y)
+    return mm(x.to_dense() if hasattr(x, "to_dense") else x,
+              y.to_dense() if hasattr(y, "to_dense") else y)
 
 
-def add(x, y, name=None):
-    return x + y
+def masked_matmul(x, y, mask, name=None):
+    """Dense @ dense, sampled at `mask`'s sparsity (reference: SDDMM)."""
+    d = jnp.matmul(
+        x._data if isinstance(x, Tensor) else jnp.asarray(x),
+        y._data if isinstance(y, Tensor) else jnp.asarray(y))
+    if isinstance(mask, SparseCooTensor):
+        vals = d[tuple(mask._indices._data)]
+        return SparseCooTensor(mask._indices, Tensor._wrap(vals), d.shape)
+    if isinstance(mask, SparseCsrTensor):
+        rows = _csr_rows(np.asarray(mask._crows._data))
+        vals = d[rows, mask._cols._data]
+        return SparseCsrTensor(mask._crows, mask._cols,
+                               Tensor._wrap(vals), d.shape)
+    raise TypeError("mask must be a sparse tensor")
 
 
-def multiply(x, y, name=None):
-    return x * y
+def mv(x, vec, name=None):
+    return Tensor._wrap(jnp.matmul(
+        x._data, vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)))
 
 
-def relu(x, name=None):
-    from ..nn.functional import relu as r
-    return r(x)
+def _same_pattern(x, y):
+    return (isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor)
+            and x._indices.shape == y._indices.shape
+            and bool(jnp.all(x._indices._data == y._indices._data)))
+
+
+def _binary(opname, op, values_only=False):
+    """Same-pattern sparse pairs operate on values (sparse out); mixed or
+    different-pattern inputs fall back to the dense backing (dense out,
+    autograd preserved via apply_op).  `values_only` (divide): the dense
+    fallback would compute 0/0 outside the support, so it is refused."""
+    def fn(x, y, name=None):
+        if _same_pattern(x, y):
+            nv = apply_op(f"sparse_{opname}", op, x._values, y._values)
+            return _rebuild_like(x, nv)
+        sparse_in = isinstance(x, (SparseCooTensor, SparseCsrTensor)) or \
+            isinstance(y, (SparseCooTensor, SparseCsrTensor))
+        if values_only and sparse_in:
+            raise ValueError(
+                f"sparse {opname} requires operands with identical "
+                "sparsity patterns (0/0 outside the support is undefined)")
+        return apply_op(f"sparse_{opname}", op, x, y)
+    return fn
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide, values_only=True)
+
+
+def transpose(x, perm, name=None):
+    from ..tensor.manipulation import transpose as tr
+    if isinstance(x, SparseCsrTensor):
+        return to_sparse_csr(tr(x.to_dense(), perm))  # format-preserving
+    if isinstance(x, SparseCooTensor):
+        return to_sparse_coo(tr(x.to_dense(), perm))
+    return tr(x, perm)
+
+
+def reshape(x, shape, name=None):
+    from ..tensor.manipulation import reshape as rs
+    if isinstance(x, SparseCsrTensor):
+        out = rs(x.to_dense(), shape)
+        if out.ndim != 2:
+            raise ValueError("CSR reshape target must be 2-D")
+        return to_sparse_csr(out)
+    if isinstance(x, SparseCooTensor):
+        return to_sparse_coo(rs(x.to_dense(), shape))
+    return rs(x, shape)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    from ..tensor.math import sum as s
+    return s(x.to_dense() if hasattr(x, "to_dense") else x, axis=axis,
+             keepdim=keepdim)
+
+
+def isnan(x, name=None):
+    return _rebuild_like(x, jnp.isnan(x._values._data)) \
+        if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
+        else Tensor._wrap(jnp.isnan(x._data))
 
 
 class nn:
     """paddle.sparse.nn namespace — sparse conv falls back to dense conv
-    (masked); capability parity, dense speed."""
+    (masked); capability parity, dense speed (reference: sparse/nn/)."""
 
-    from ..nn import ReLU  # noqa: F401
+    from ..nn import ReLU, ReLU6, LeakyReLU, Softmax, BatchNorm  # noqa: F401
+    from ..nn import Conv2D, Conv3D  # noqa: F401

@@ -1,0 +1,128 @@
+"""paddle.sparse: COO/CSR creation, unary/binary ops, SDDMM
+(reference: python/paddle/sparse/ — creation.py, unary.py, binary.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+sp = paddle.sparse
+
+
+def _dense():
+    return paddle.to_tensor(np.asarray(
+        [[0, 2.0, 0, 1.0], [3.0, 0, 0, 0], [0, 0, -4.0, 0]], np.float32))
+
+
+class TestCreation:
+    def test_coo_roundtrip(self):
+        x = _dense()
+        c = sp.to_sparse_coo(x)
+        assert sp.nnz(c) == 4
+        assert np.allclose(np.asarray(c.to_dense().numpy()),
+                           np.asarray(x.numpy()))
+
+    def test_csr_roundtrip(self):
+        x = _dense()
+        c = sp.to_sparse_csr(x)
+        assert np.allclose(np.asarray(c.to_dense().numpy()),
+                           np.asarray(x.numpy()))
+        assert list(np.asarray(c.crows().numpy())) == [0, 2, 3, 4]
+
+    def test_sparse_coo_tensor_duplicates_sum(self):
+        c = sp.sparse_coo_tensor(np.asarray([[0, 0], [1, 1]]),
+                                 np.asarray([1.0, 2.0], np.float32),
+                                 shape=(2, 2))
+        assert float(np.asarray(c.to_dense().numpy())[0, 1]) == 3.0
+
+
+class TestUnary:
+    @pytest.mark.parametrize("name,ref", [
+        ("sin", np.sin), ("tanh", np.tanh), ("square", np.square),
+        ("abs", np.abs), ("neg", np.negative), ("expm1", np.expm1),
+        ("relu", lambda v: np.maximum(v, 0))])
+    def test_value_ops_preserve_pattern(self, name, ref):
+        x = _dense()
+        c = sp.to_sparse_coo(x)
+        out = getattr(sp, name)(c)
+        assert np.allclose(np.asarray(out.to_dense().numpy()),
+                           ref(np.asarray(x.numpy())), atol=1e-6), name
+        assert sp.nnz(out) == sp.nnz(c)  # same sparsity pattern
+
+    def test_pow_and_cast(self):
+        c = sp.to_sparse_coo(_dense())
+        p = sp.pow(c, 2)
+        assert np.allclose(np.asarray(p.to_dense().numpy()),
+                           np.asarray(_dense().numpy()) ** 2)
+        c2 = sp.cast(c, value_dtype="float64")
+        assert c2 is not None
+
+
+class TestBinary:
+    def test_add_subtract(self):
+        a = sp.to_sparse_coo(_dense())
+        b = sp.to_sparse_coo(_dense())
+        out = sp.add(a, b)
+        assert np.allclose(np.asarray(out.to_dense().numpy()),
+                           2 * np.asarray(_dense().numpy()))
+        z = sp.subtract(a, b)
+        assert np.allclose(np.asarray(z.to_dense().numpy()), 0)
+
+    def test_matmul_and_mv(self):
+        a = sp.to_sparse_coo(_dense())          # [3, 4]
+        d = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 2).astype(np.float32))
+        out = sp.matmul(a, d)
+        ref = np.asarray(_dense().numpy()) @ np.asarray(d.numpy())
+        assert np.allclose(np.asarray(out.numpy()), ref, atol=1e-5)
+        v = paddle.to_tensor(np.ones(4, np.float32))
+        assert np.allclose(np.asarray(sp.mv(a, v).numpy()),
+                           np.asarray(_dense().numpy()).sum(1), atol=1e-6)
+
+    def test_masked_matmul_sddmm(self):
+        rng = np.random.RandomState(1)
+        a = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+        b = paddle.to_tensor(rng.randn(4, 3).astype(np.float32))
+        mask = sp.to_sparse_coo(paddle.to_tensor(
+            np.eye(3, dtype=np.float32)))
+        out = sp.masked_matmul(a, b, mask)
+        ref = (np.asarray(a.numpy()) @ np.asarray(b.numpy())) * np.eye(3)
+        assert np.allclose(np.asarray(out.to_dense().numpy()), ref,
+                           atol=1e-5)
+
+
+class TestAutograd:
+    def test_dense_path_keeps_gradients(self):
+        """sparse.relu / sparse.add on dense tensors route through the
+        dispatch (round-5 review regression: raw jnp calls dropped the
+        autograd tape)."""
+        x = paddle.to_tensor(np.asarray([[1.0, -2.0], [3.0, -4.0]],
+                                        np.float32))
+        x.stop_gradient = False
+        out = sp.relu(x)
+        assert not out.stop_gradient
+        out.sum().backward()
+        g = np.asarray(x.grad.numpy())
+        assert np.allclose(g, (np.asarray(x.numpy()) > 0).astype(np.float32))
+
+    def test_divide_mismatched_pattern_raises(self):
+        a = sp.to_sparse_coo(_dense())
+        b = sp.to_sparse_coo(paddle.to_tensor(
+            np.asarray([[1.0, 0, 0, 0], [0, 0, 0, 0], [0, 0, 0, 0]],
+                       np.float32)))
+        with pytest.raises(ValueError, match="sparsity patterns"):
+            sp.divide(a, b)
+
+    def test_csr_transpose_preserves_format(self):
+        c = sp.to_sparse_csr(_dense())
+        t = sp.transpose(c, [1, 0])
+        assert hasattr(t, "crows")  # still CSR
+        assert np.allclose(np.asarray(t.to_dense().numpy()),
+                           np.asarray(_dense().numpy()).T)
+
+
+class TestSparseNN:
+    def test_relu_layer(self):
+        layer = sp.nn.ReLU()
+        out = layer(_dense())
+        assert float(np.asarray(out.numpy()).min()) >= 0
