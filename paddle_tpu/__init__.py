@@ -48,6 +48,7 @@ from . import distributed  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+from . import inference  # noqa: F401
 from . import framework  # noqa: F401
 from . import geometric  # noqa: F401
 from . import hapi  # noqa: F401

@@ -23,8 +23,16 @@ def annotate_param(param, spec):
         try:
             param._data = jax.device_put(param._data,
                                          NamedSharding(mesh, spec))
-        except Exception:
-            pass
+        except Exception as e:
+            # parameter creation must not hard-fail, but a param that
+            # LOOKS annotated while actually replicated is a silent
+            # memory/perf bug — surface it
+            import warnings
+            warnings.warn(
+                f"annotate_param: could not place shape "
+                f"{tuple(param._data.shape)} as {spec} on mesh "
+                f"{dict(zip(mesh.axis_names, mesh.devices.shape))}: {e}; "
+                "parameter stays replicated", RuntimeWarning, stacklevel=2)
     return param
 
 

@@ -248,7 +248,8 @@ class DistributedBatchSampler(BatchSampler):
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        from .native import collate_stack
+        return Tensor(collate_stack(batch))
     if isinstance(sample, Tensor):
         from ..tensor.manipulation import stack
         return stack(batch, 0)

@@ -1,0 +1,105 @@
+"""ctypes bindings for the native collation engine (io/_native/collate.cc).
+
+Reference analogue: the C++ reader/feed internals (buffered_reader.cc).
+The library builds lazily with g++ on first use and caches next to the
+source; every entry point falls back to numpy when the toolchain or the
+input layout doesn't qualify.  On a single-core host the copies are
+memory-bandwidth-bound either way (numpy parity); the threaded fan-out
+pays off on real multi-core TPU-VM hosts where the feed pipeline
+competes with the training step for the Python thread.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")
+_SRC = os.path.join(_DIR, "collate.cc")
+_LIB = os.path.join(_DIR, "libptpu_collate.so")
+_lock = threading.Lock()
+_lib = [None]   # ctypes.CDLL | False (build failed) | None (not tried)
+
+
+def _load():
+    if _lib[0] is not None:
+        return _lib[0]
+    with _lock:
+        if _lib[0] is not None:
+            return _lib[0]
+        try:
+            if (not os.path.exists(_LIB)
+                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                tmp = f"{_LIB}.{os.getpid()}.tmp"  # unique: parallel
+                # first-use builds from sibling processes must not clobber
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-pthread", _SRC, "-o", tmp],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _LIB)
+            lib = ctypes.CDLL(_LIB)
+            lib.ptpu_collate.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int]
+            lib.ptpu_gather_rows.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int]
+            _lib[0] = lib
+        except Exception:
+            _lib[0] = False
+        return _lib[0]
+
+
+def native_available():
+    return bool(_load())
+
+
+_NT = min(8, os.cpu_count() or 1)
+
+
+def collate_stack(arrays):
+    """np.stack(arrays) via the native engine; numpy fallback when the
+    items aren't large same-shape contiguous buffers."""
+    lib = _load()
+    n = len(arrays)
+    first = arrays[0]
+    if (not lib or n < 2 or first.nbytes * n < (1 << 20)
+            or first.dtype.hasobject  # PyObject* must be refcounted
+            or any(a.shape != first.shape or a.dtype != first.dtype
+                   or not a.flags.c_contiguous for a in arrays)):
+        return np.stack(arrays)
+    out = np.empty((n,) + first.shape, first.dtype)
+    ptrs = (ctypes.c_void_p * n)(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays])
+    lib.ptpu_collate(ptrs, n, first.nbytes,
+                     out.ctypes.data_as(ctypes.c_void_p), _NT)
+    return out
+
+
+def gather_rows(src, idx):
+    """src[idx] along dim 0 via the native engine (the sampler fast path);
+    numpy fallback for small or non-contiguous inputs."""
+    lib = _load()
+    idx = np.ascontiguousarray(idx, np.int64)
+    nrows = src.shape[0]
+    # numpy index semantics BEFORE the raw-pointer path: wrap negatives,
+    # reject out-of-bounds (memcpy would silently read garbage)
+    idx = np.where(idx < 0, idx + nrows, idx)
+    if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= nrows):
+        raise IndexError(
+            f"gather_rows: index out of bounds for axis 0 of size {nrows}")
+    row_bytes = src.nbytes // max(nrows, 1)
+    if (not lib or not src.flags.c_contiguous or src.dtype.hasobject
+            or idx.size * row_bytes < (1 << 20)):
+        return src[idx]
+    out = np.empty((idx.size,) + src.shape[1:], src.dtype)
+    lib.ptpu_gather_rows(
+        src.ctypes.data_as(ctypes.c_void_p),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        idx.size, row_bytes, out.ctypes.data_as(ctypes.c_void_p), _NT)
+    return out

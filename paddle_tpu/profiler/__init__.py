@@ -74,7 +74,11 @@ class Profiler:
             try:
                 jax.profiler.start_trace(self._dir)
                 self._running = True
-            except Exception:
+            except Exception as e:
+                import warnings
+                warnings.warn(f"profiler trace did not start: {e} "
+                              "(timer-only mode continues)", RuntimeWarning,
+                              stacklevel=2)
                 self._running = False
 
     def stop(self):

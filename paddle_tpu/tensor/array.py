@@ -1,0 +1,57 @@
+"""TensorArray API (reference: python/paddle/tensor/array.py —
+array_read:25, array_length:95, array_write:164, create_array:261).
+
+TPU-native: the reference's TensorArray is a variable-length list variable
+for static-graph loops; in the jit-tracing world a python list of arrays
+serves the same role (appends happen at trace time, and `lax.scan` is the
+compiled-loop form).  This module keeps the four-function API for ported
+user code."""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """reference: array.py:261 — returns the (python-list) TensorArray."""
+    arr = []
+    if initialized_list is not None:
+        for t in initialized_list:
+            if not isinstance(t, Tensor):
+                raise TypeError(
+                    f"initialized_list entries must be Tensors, got "
+                    f"{type(t).__name__}")
+            arr.append(t)
+    return arr
+
+
+def array_write(x, i, array=None):
+    """Write x at index i, growing the array if i == len (reference
+    array.py:164 semantics)."""
+    if not isinstance(x, Tensor):
+        raise TypeError("x must be a Tensor")
+    idx = int(i) if not isinstance(i, Tensor) else int(i.numpy())
+    if array is None:
+        array = []
+    if idx > len(array):
+        raise IndexError(
+            f"array_write index {idx} > array length {len(array)}")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    """reference: array.py:25."""
+    idx = int(i) if not isinstance(i, Tensor) else int(i.numpy())
+    if not 0 <= idx < len(array):
+        raise IndexError(f"array_read index {idx} out of range "
+                         f"[0, {len(array)})")
+    return array[idx]
+
+
+def array_length(array):
+    """reference: array.py:95."""
+    return len(array)

@@ -186,3 +186,25 @@ class TestRecompute:
         g2 = np_t(lin.weight.grad)
         assert np.allclose(np_t(y1), np_t(y2), atol=1e-6)
         assert np.allclose(g1, g2, atol=1e-5)
+
+
+class TestInferencePredictor:
+    def test_config_predictor_roundtrip(self, tmp_path):
+        """paddle.inference Config/Predictor over a jit.save artifact
+        (reference: AnalysisPredictor named-handle contract)."""
+        from paddle_tpu import inference
+        from paddle_tpu.static import InputSpec
+        net = nn.Sequential(nn.Linear(3, 2))
+        x = paddle.randn([2, 3])
+        want = np_t(net(x))
+        paddle.jit.save(net, str(tmp_path / "m"),
+                        input_spec=[InputSpec([2, 3], "float32")])
+        cfg = inference.Config(str(tmp_path / "m"))
+        pred = inference.create_predictor(cfg)
+        names = pred.get_input_names()
+        assert names == ["input_0"]
+        pred.get_input_handle(names[0]).copy_from_cpu(np_t(x))
+        assert pred.run()
+        out = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        assert np.allclose(out, want, atol=1e-6)

@@ -186,3 +186,28 @@ class TestDtype:
         assert y.dtype == np.int32
         z = x.astype(paddle.bfloat16)
         assert "bfloat16" in str(z.dtype)
+
+
+class TestTensorArray:
+    """TensorArray API (reference: tensor/array.py — list-variable for
+    loop constructs; python-list backed in the jit-tracing world)."""
+
+    def test_write_read_length(self):
+        arr = paddle.tensor.create_array("float32")
+        arr = paddle.tensor.array_write(paddle.ones([2]), 0, arr)
+        arr = paddle.tensor.array_write(paddle.zeros([2]), 1, arr)
+        assert paddle.tensor.array_length(arr) == 2
+        assert np.allclose(np.asarray(
+            paddle.tensor.array_read(arr, 0).numpy()), 1.0)
+        # overwrite
+        arr = paddle.tensor.array_write(paddle.full([2], 7.0), 0, arr)
+        assert np.allclose(np.asarray(
+            paddle.tensor.array_read(arr, 0).numpy()), 7.0)
+
+    def test_bounds(self):
+        import pytest
+        arr = paddle.tensor.create_array()
+        with pytest.raises(IndexError):
+            paddle.tensor.array_write(paddle.ones([1]), 3, arr)
+        with pytest.raises(IndexError):
+            paddle.tensor.array_read(arr, 0)
