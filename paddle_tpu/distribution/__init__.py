@@ -1,5 +1,10 @@
 """Probability distributions (reference: python/paddle/distribution/)."""
-from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,  # noqa: F401
+from .distributions import (Bernoulli, Beta, Binomial, Categorical,  # noqa: F401
+                            Cauchy, ContinuousBernoulli, Dirichlet,
                             Distribution, Exponential, Gamma, Geometric,
-                            Gumbel, Laplace, LogNormal, Multinomial, Normal,
-                            Poisson, StudentT, Uniform, kl_divergence)
+                            Gumbel, Independent, Laplace, LogNormal,
+                            Multinomial, MultivariateNormal, Normal,
+                            Poisson, StudentT, TransformedDistribution,
+                            Uniform, kl_divergence)
+from .distributions import (AffineTransform, ExpTransform,  # noqa: F401
+                            SigmoidTransform, Transform)

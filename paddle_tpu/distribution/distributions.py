@@ -360,6 +360,263 @@ class StudentT(Distribution):
                   - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
 
 
+class Binomial(Distribution):
+    """reference: distribution/binomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.n = _d(total_count)
+        self.probs = _d(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    def sample(self, shape=()):
+        bshape = jnp.broadcast_shapes(jnp.shape(self.n),
+                                      jnp.shape(self.probs))
+        shape = tuple(shape) + bshape
+        # O(shape) sampler (a per-trial draw would be O(n * shape) memory)
+        return _w(jax.random.binomial(_next_key(), self.n, self.probs,
+                                      shape=shape))
+
+    def log_prob(self, value):
+        v = _d(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _w(jax.scipy.special.gammaln(self.n + 1)
+                  - jax.scipy.special.gammaln(v + 1)
+                  - jax.scipy.special.gammaln(self.n - v + 1)
+                  + v * jnp.log(p) + (self.n - v) * jnp.log1p(-p))
+
+    @property
+    def mean(self):
+        return _w(self.n * self.probs)
+
+    @property
+    def variance(self):
+        return _w(self.n * self.probs * (1 - self.probs))
+
+
+class Cauchy(Distribution):
+    """reference: distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+        super().__init__(jnp.shape(self.loc))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale))
+        u = jax.random.uniform(_next_key(), shape, minval=1e-6,
+                               maxval=1 - 1e-6)
+        return _w(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_d(value) - self.loc) / self.scale
+        return _w(-jnp.log(math.pi * self.scale * (1 + z ** 2)))
+
+    def cdf(self, value):
+        z = (_d(value) - self.loc) / self.scale
+        return _w(jnp.arctan(z) / math.pi + 0.5)
+
+    def entropy(self):
+        return _w(jnp.log(4 * math.pi * self.scale)
+                  * jnp.ones(jnp.shape(self.loc)))
+
+
+class ContinuousBernoulli(Distribution):
+    """reference: distribution/continuous_bernoulli.py — [0,1]-supported
+    exponential-family relaxation of Bernoulli."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = jnp.clip(_d(probs), 1e-4, 1 - 1e-4)
+        # half-width of the numerically-unstable band around p = 0.5 where
+        # the closed forms degenerate and the p->0.5 limits are used
+        self._band = float(lims[1]) - 0.5
+        super().__init__(jnp.shape(self.probs))
+
+    def _log_norm(self):
+        p = self.probs
+        # C(p) = 2 atanh(1-2p) / (1-2p), -> 2 at p=0.5 (use the limit in
+        # the unstable band)
+        safe = jnp.where(jnp.abs(p - 0.5) < self._band, 0.4, p)
+        c = (2 * jnp.arctanh(1 - 2 * safe)) / (1 - 2 * safe)
+        return jnp.where(jnp.abs(p - 0.5) < self._band, jnp.log(2.0),
+                         jnp.log(c))
+
+    def log_prob(self, value):
+        v = _d(value)
+        p = self.probs
+        return _w(v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                  + self._log_norm())
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.shape(self.probs)
+        u = jax.random.uniform(_next_key(), shape, minval=1e-6,
+                               maxval=1 - 1e-6)
+        p = self.probs
+        # inverse CDF; p ~ 0.5 degenerates to uniform
+        num = jnp.log1p(u * (2 * p - 1) / (1 - p))
+        den = jnp.log(p) - jnp.log1p(-p)
+        return _w(jnp.where(jnp.abs(p - 0.5) < self._band, u, num / den))
+
+
+class MultivariateNormal(Distribution):
+    """reference: distribution/multivariate_normal.py."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _d(loc)
+        if scale_tril is not None:
+            self._tril = _d(scale_tril)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_d(covariance_matrix))
+        else:
+            raise ValueError("pass covariance_matrix or scale_tril")
+        super().__init__(jnp.shape(self.loc)[:-1], jnp.shape(self.loc)[-1:])
+
+    @property
+    def covariance_matrix(self):
+        return _w(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    @property
+    def mean(self):
+        return _w(self.loc)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.shape(self.loc)
+        z = jax.random.normal(_next_key(), shape)
+        return _w(self.loc + jnp.einsum("...ij,...j->...i", self._tril, z))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        d = jnp.shape(self.loc)[-1]
+        diff = _d(value) - self.loc
+        sol = jax.scipy.linalg.solve_triangular(self._tril, diff[..., None],
+                                                lower=True)[..., 0]
+        logdet = jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1))), -1)
+        return _w(-0.5 * jnp.sum(sol ** 2, -1) - logdet
+                  - 0.5 * d * jnp.log(2 * jnp.asarray(math.pi)))
+
+    def entropy(self):
+        d = jnp.shape(self.loc)[-1]
+        logdet = jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1))), -1)
+        return _w(0.5 * d * (1 + jnp.log(2 * jnp.asarray(math.pi)))
+                  + logdet)
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference:
+    distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = tuple(base.batch_shape)
+        if not 0 <= self.rank <= len(bs):
+            raise ValueError(
+                f"reinterpreted_batch_rank {self.rank} exceeds the base "
+                f"distribution's batch rank {len(bs)}")
+        super().__init__(bs[: len(bs) - self.rank],
+                         bs[len(bs) - self.rank:]
+                         + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = _d(self.base.log_prob(value))
+        return _w(jnp.sum(lp, axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        e = _d(self.base.entropy())
+        return _w(jnp.sum(e, axis=tuple(range(-self.rank, 0))))
+
+
+class Transform:
+    """reference: distribution/transform.py."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+
+    def forward(self, x):
+        return _w(self.loc + self.scale * _d(x))
+
+    def inverse(self, y):
+        return _w((_d(y) - self.loc) / self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return _w(jnp.broadcast_to(jnp.log(jnp.abs(self.scale)),
+                                   jnp.shape(_d(x))))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _w(jnp.exp(_d(x)))
+
+    def inverse(self, y):
+        return _w(jnp.log(_d(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _w(_d(x))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return _w(jax.nn.sigmoid(_d(x)))
+
+    def inverse(self, y):
+        yv = jnp.clip(_d(y), 1e-7, 1 - 1e-7)
+        return _w(jnp.log(yv) - jnp.log1p(-yv))
+
+    def forward_log_det_jacobian(self, x):
+        xv = _d(x)
+        return _w(-jax.nn.softplus(-xv) - jax.nn.softplus(xv))
+
+
+class TransformedDistribution(Distribution):
+    """reference: distribution/transformed_distribution.py."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = value
+        log_det = 0.0
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            log_det = log_det + _d(t.forward_log_det_jacobian(x))
+            y = x
+        # the elementwise log-det reduces over the base's EVENT dims (the
+        # base log_prob is already event-reduced)
+        ev = len(tuple(self.base.event_shape))
+        if ev and jnp.ndim(log_det):
+            log_det = jnp.sum(log_det, axis=tuple(range(-ev, 0)))
+        return _w(_d(self.base.log_prob(y)) - log_det)
+
+
 def kl_divergence(p, q):
     if isinstance(p, Normal) and isinstance(q, Normal):
         var_ratio = (p.scale / q.scale) ** 2

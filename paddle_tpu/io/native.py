@@ -102,4 +102,5 @@ def gather_rows(src, idx):
         src.ctypes.data_as(ctypes.c_void_p),
         idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         idx.size, row_bytes, out.ctypes.data_as(ctypes.c_void_p), _NT)
-    return out
+    # numpy fancy-index shape semantics: out shape = idx.shape + row shape
+    return out.reshape(idx.shape + src.shape[1:])

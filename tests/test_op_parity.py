@@ -41,6 +41,23 @@ def _sm(x, axis=-1):
     return e / e.sum(axis=axis, keepdims=True)
 
 
+def _conv2d_ref(x, w, stride, pad):
+    """Direct NCHW convolution (cross-correlation) reference."""
+    if pad:
+        x = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    B, C, H, W = x.shape
+    O, _, kh, kw = w.shape
+    oh = (H - kh) // stride + 1
+    ow = (W - kw) // stride + 1
+    out = np.zeros((B, O, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, :, i * stride:i * stride + kh,
+                      j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("bchw,ochw->bo", patch, w)
+    return out
+
+
 CASES = [
     # ---- elementwise binary -------------------------------------------------
     OpCase("add", paddle.add, lambda a, b: a + b, [X, Y]),
@@ -251,6 +268,43 @@ CASES = [
            lambda a, b: (a * b).sum(-1) /
            (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1)),
            [X, Y]),
+    # ---- conv / pool / vision functional ------------------------------------
+    OpCase("conv2d",
+           lambda x, w: F.conv2d(x, w, stride=1, padding=1),
+           lambda x, w: _conv2d_ref(x, w, 1, 1),
+           [A(8, 2, 6, 6), A(3, 2, 3, 3)],
+           tol={"bfloat16": (4e-2, 4e-2), "float16": (5e-3, 5e-3)},
+           max_relative_error=0.2),  # fd noise over many accum terms
+    OpCase("conv2d_stride2",
+           lambda x, w: F.conv2d(x, w, stride=2, padding=0),
+           lambda x, w: _conv2d_ref(x, w, 2, 0),
+           [A(8, 2, 6, 6), A(3, 2, 3, 3)],
+           tol={"bfloat16": (4e-2, 4e-2), "float16": (5e-3, 5e-3)},
+           max_relative_error=0.2),
+    OpCase("max_pool2d",
+           lambda x: F.max_pool2d(x, kernel_size=2, stride=2),
+           lambda x: x.reshape(8, 2, 3, 2, 3, 2).max(5).max(3),
+           [A(8, 2, 6, 6)], grad=False),
+    OpCase("avg_pool2d",
+           lambda x: F.avg_pool2d(x, kernel_size=2, stride=2),
+           lambda x: x.reshape(8, 2, 3, 2, 3, 2).mean(5).mean(3),
+           [A(8, 2, 6, 6)]),
+    OpCase("adaptive_avg_pool2d",
+           lambda x: F.adaptive_avg_pool2d(x, 1),
+           lambda x: x.mean((2, 3), keepdims=True), [A(8, 2, 6, 6)]),
+    OpCase("interpolate_nearest",
+           lambda x: F.interpolate(x, scale_factor=2, mode="nearest"),
+           lambda x: x.repeat(2, axis=2).repeat(2, axis=3),
+           [A(8, 2, 3, 3)], grad=False),
+    OpCase("normalize",
+           lambda x: F.normalize(x, axis=-1),
+           lambda x: x / np.maximum(
+               np.linalg.norm(x, axis=-1, keepdims=True), 1e-12), [X]),
+    OpCase("pixel_shuffle",
+           lambda x: F.pixel_shuffle(x, 2),
+           lambda x: x.reshape(8, 1, 2, 2, 3, 3).transpose(
+               0, 1, 4, 2, 5, 3).reshape(8, 1, 6, 6),
+           [A(8, 4, 3, 3)], grad=False),
     # ---- misc ---------------------------------------------------------------
     OpCase("allclose", paddle.allclose, np.allclose, [X, X], grad=False,
            dtypes=("float32",), sharded=False),
