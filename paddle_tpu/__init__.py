@@ -73,7 +73,7 @@ from .device import (CPUPlace, CUDAPlace, TPUPlace, XPUPlace, get_device,  # noq
 from .framework import (get_default_dtype, in_dynamic_mode,  # noqa: F401
                         in_dynamic_or_pir_mode, in_pir_mode, load, save,
                         set_default_dtype)
-from .hapi import Model, summary  # noqa: F401
+from .hapi import Model, flops, summary  # noqa: F401
 from .jit import disable_static, enable_static  # noqa: F401
 from .nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 

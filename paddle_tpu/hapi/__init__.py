@@ -302,3 +302,44 @@ def summary(net, input_size=None, dtypes=None, input=None):
     print(f"Trainable params: {trainable:,}")
     print(sep)
     return {"total_params": total_params, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Model FLOPs for one forward pass (reference: hapi/dynamic_flops.py —
+    per-layer hook estimates).
+
+    TPU-native: instead of per-layer formulas, trace the forward under jit
+    and read XLA's own cost analysis — exact for whatever the compiler
+    will actually run (fusions included)."""
+    import jax
+    import jax.numpy as jnp
+    from ..jit import (bind_layer_state, eval_mode, functional_forward,
+                       layer_state)
+
+    if custom_ops:
+        import warnings
+        warnings.warn(
+            "paddle.flops: custom_ops is ignored — counts come from XLA's "
+            "cost analysis of the traced forward, not per-layer hooks",
+            RuntimeWarning, stacklevel=2)
+    shape = tuple(int(s) for s in input_size)
+    params, buffers = layer_state(net)
+    fwd = functional_forward(net)
+    with eval_mode(net):
+        try:
+            x = jnp.zeros(shape, jnp.float32)
+            compiled = jax.jit(fwd).lower(params, buffers, x).compile()
+            cost = compiled.cost_analysis() or {}
+        finally:
+            bind_layer_state(net, params, buffers)
+    if "flops" not in cost:
+        raise RuntimeError(
+            "XLA cost analysis returned no 'flops' entry on this backend; "
+            f"keys: {sorted(cost)}")
+    total = int(cost["flops"])
+    if print_detail:
+        print(f"FLOPs (XLA cost analysis, input {shape}): {total:,}")
+        for k in ("bytes accessed", "transcendentals"):
+            if k in cost:
+                print(f"  {k}: {int(cost[k]):,}")
+    return total

@@ -304,6 +304,45 @@ class CompiledTrainStep:
         return Tensor._wrap(loss)
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def eval_mode(layer):
+    """Temporarily put a Layer in eval mode, restoring the EXACT
+    per-sublayer training flags afterwards (a bare .train() would flatten
+    mixed-mode models — e.g. re-enable a deliberately frozen BatchNorm)."""
+    states = [(sub, sub.training)
+              for _, sub in layer.named_sublayers(include_self=True)]
+    layer.eval()
+    try:
+        yield
+    finally:
+        for sub, was in states:
+            sub.training = was
+
+
+def functional_forward(layer, fn=None):
+    """The functionalize-a-Layer trace harness shared by jit.save and
+    hapi.flops: returns pure(params, buffers, *xs) -> pytree of raw
+    arrays, with parameters bound, tracing depth set, and grad off."""
+    call = fn if fn is not None else layer.forward
+
+    def pure(params, buffers, *xs):
+        bind_layer_state(layer, params, buffers)
+        STATE.tracing_depth += 1
+        try:
+            with no_grad_guard():
+                out = call(*[Tensor._wrap(x) for x in xs])
+        finally:
+            STATE.tracing_depth -= 1
+        return jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    return pure
+
+
 # ---------------------------------------------------------------------------
 # save / load — serialized-program deployment artifact.
 #
@@ -369,33 +408,18 @@ def save(layer, path, input_spec=None, **configs):
 
     structs = [_to_struct(s) for s in input_spec]
     params, buffers = layer_state(target)
-    was_training = target.training
-    target.eval()
-
-    def pure(params, buffers, *xs):
-        bind_layer_state(target, params, buffers)
-        STATE.tracing_depth += 1
+    pure = functional_forward(target, fn)
+    with eval_mode(target):
         try:
-            with no_grad_guard():
-                out = fn(*[Tensor._wrap(x) for x in xs])
+            p_structs = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+            b_structs = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers)
+            exported = jexport.export(jax.jit(pure))(p_structs, b_structs,
+                                                     *structs)
+            blob = exported.serialize()
         finally:
-            STATE.tracing_depth -= 1
-        return jax.tree_util.tree_map(
-            lambda t: t._data if isinstance(t, Tensor) else t, out,
-            is_leaf=lambda t: isinstance(t, Tensor))
-
-    try:
-        p_structs = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
-        b_structs = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers)
-        exported = jexport.export(jax.jit(pure))(p_structs, b_structs,
-                                                 *structs)
-        blob = exported.serialize()
-    finally:
-        bind_layer_state(target, params, buffers)
-        if was_training:
-            target.train()
+            bind_layer_state(target, params, buffers)
     with open(path + ".pdmodel", "wb") as f:
         f.write(blob)
     np.savez(path + ".pdparams",

@@ -208,3 +208,17 @@ class TestInferencePredictor:
         out = pred.get_output_handle(
             pred.get_output_names()[0]).copy_to_cpu()
         assert np.allclose(out, want, atol=1e-6)
+
+
+class TestEvalMode:
+    def test_mixed_mode_restored(self):
+        """eval_mode restores PER-SUBLAYER training flags — a frozen BN in
+        a training model must stay frozen after jit.save/flops."""
+        from paddle_tpu.jit import eval_mode
+        net = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4))
+        net.train()
+        net[1].eval()  # deliberately frozen sublayer
+        with eval_mode(net):
+            assert not net.training and not net[1].training
+        assert net.training and net[0].training
+        assert not net[1].training  # frozen stays frozen

@@ -244,3 +244,13 @@ class TestAmpIntegration:
         loss.backward()
         opt.step()
         assert net.weight.grad is None or True  # step consumed grads
+
+
+class TestFlops:
+    def test_flops_matches_matmul_count(self):
+        """paddle.flops via XLA cost analysis ~= analytic 2*M*N*K."""
+        net = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                            nn.Linear(128, 10))
+        got = paddle.flops(net, [4, 64])
+        expect = 2 * (64 * 128 + 128 * 10) * 4
+        assert abs(got - expect) / expect < 0.05, (got, expect)
