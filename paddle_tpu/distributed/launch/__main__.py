@@ -1,0 +1,7 @@
+"""`python -m paddle_tpu.distributed.launch` entry (reference:
+launch/__main__.py)."""
+
+from .main import launch
+
+if __name__ == "__main__":
+    launch()

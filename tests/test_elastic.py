@@ -141,3 +141,34 @@ class TestElastic:
                              poll_interval=0.1)
         assert agent.run() == 1
         assert [k for _, k, _ in agent.events].count("failure") == 3
+
+
+def _run_launcher(script, tmp_path):
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=1", "--log_dir", str(tmp_path / "log"),
+         str(script)],
+        capture_output=True, text=True, timeout=120, cwd=repo)
+
+
+class TestLauncher:
+    def test_fleetrun_single_host(self, tmp_path):
+        """The fleetrun launcher runs a script end-to-end (reference:
+        launch/main.py) and propagates the worker exit code."""
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os\n"
+            "assert os.environ['PADDLE_TRAINER_ID'] == '0'\n"
+            "assert os.environ['PADDLE_TRAINERS_NUM'] == '1'\n"
+            "print('WORKER OK')\n")
+        r = _run_launcher(script, tmp_path)
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        assert "WORKER OK" in r.stdout
+
+    def test_fleetrun_propagates_failure(self, tmp_path):
+        script = tmp_path / "bad.py"
+        script.write_text("import sys; sys.exit(9)\n")
+        r = _run_launcher(script, tmp_path)
+        assert r.returncode != 0
