@@ -1,0 +1,40 @@
+"""Op definition layer (L3): YAML specs -> generated API + metadata tables.
+
+Reference analogue: /root/reference/paddle/phi/ops/yaml/ (ops.yaml 434 ops,
+backward.yaml 323 grad ops) + the generators in paddle/phi/api/generator/.
+Here one spec in ``ops.yaml`` generates (via scripts/gen_ops.py, output
+checked in as ``_generated.py``):
+
+  - the public API function (exported through paddle.tensor namespaces),
+  - ``KERNELS`` (traceable kernel table),
+  - ``META`` + :func:`infer_meta` (shape/dtype inference via jax.eval_shape —
+    the InferMeta analogue),
+  - ``SPMD_RULES`` + :func:`spmd.propagate` (sharding propagation table),
+  - ``OP_SPECS`` (introspection; drives the auto parity suite in
+    tests/test_generated_ops.py).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from . import spmd  # noqa: F401
+from ._generated import *  # noqa: F401,F403
+from ._generated import KERNELS, META, OP_SPECS, SPMD_RULES  # noqa: F401
+from .spmd import propagate  # noqa: F401
+
+
+def infer_meta(op_name, *args, **attrs):
+    """Shape/dtype inference without execution (InferMeta analogue).
+
+    ``args`` are arrays or ``jax.ShapeDtypeStruct``s; returns the op's output
+    as ``jax.ShapeDtypeStruct``(s).  Implemented as ``jax.eval_shape`` over
+    the op's kernel — the compiler's abstract interpreter IS the shape
+    function, so it can never drift from the kernel (the reference maintains
+    434 hand-written C++ InferMeta functions for this,
+    /root/reference/paddle/phi/infermeta/).
+    """
+    fn = META.get(op_name)
+    if fn is None:
+        raise KeyError(f"op '{op_name}' has no meta entry in ops.yaml")
+    return jax.eval_shape(lambda *xs: fn(*xs, **attrs), *args)

@@ -1,0 +1,43 @@
+"""Shared runtime helpers for the YAML-generated op API (_generated.py).
+
+The generated functions are thin: argument normalisation lives here so the
+emitted code stays readable and the YAML specs stay declarative.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _axis(axis):
+    """Normalise paddle's axis argument (None | int | list | Tensor)."""
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy()
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _wrap_logic(fn, x, y=None, out=None):
+    """Comparison/bitwise ops: no autograd tape (discrete outputs), but the
+    same Tensor-in/Tensor-out contract.  Mirrors the reference's logic ops,
+    which register no grad kernels (phi/ops/yaml/ops.yaml has no
+    equal_grad/bitwise_and_grad entries)."""
+    if y is None:
+        r = Tensor._wrap(fn(_t(x)._data))
+    else:
+        yd = y if isinstance(y, (int, float, bool)) else _t(y)._data
+        r = Tensor._wrap(fn(_t(x)._data, yd))
+    if out is not None:
+        out._data = r._data
+        return out
+    return r

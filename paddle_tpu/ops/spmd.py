@@ -1,0 +1,100 @@
+"""Sharding-propagation rules for the YAML op corpus.
+
+Reference analogue: the per-op ``spmd_rule:`` entries in
+/root/reference/paddle/phi/ops/yaml/ops.yaml (e.g. ``ElementwiseInferSpmd``,
+``ReductionInferSpmd`` in paddle/phi/infermeta/spmd_rules/).  There the rules
+*drive* partitioning decisions; on TPU GSPMD already propagates shardings
+through the whole XLA program, so this table's role is (a) a queryable,
+documented statement of how each op treats shardings — used by
+``paddle.static``'s program printer and available to auto-parallel tooling —
+and (b) a consistency check: tests/test_generated_ops.py asserts these
+predictions match GSPMD's actual output shardings on a real mesh.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec
+
+
+def _norm(spec, ndim):
+    """PartitionSpec -> length-ndim tuple of axis-name-or-None."""
+    entries = tuple(spec) if spec is not None else ()
+    entries = entries + (None,) * (ndim - len(entries))
+    return entries[:ndim]
+
+
+def _merge_dim(a, b):
+    if a is None:
+        return b
+    if b is None or a == b:
+        return a
+    raise ValueError(f"conflicting shardings on one dim: {a} vs {b}")
+
+
+def elementwise(input_specs, input_ndims, **attrs):
+    """Broadcast-aware elementwise: align dims from the trailing side, merge
+    per-dim (first non-replicated wins; conflicting mesh axes is an error)."""
+    out_ndim = max(input_ndims) if input_ndims else 0
+    out = [None] * out_ndim
+    for spec, nd in zip(input_specs, input_ndims):
+        dims = _norm(spec, nd)
+        for i, d in enumerate(dims):
+            oi = i + (out_ndim - nd)  # right-aligned (numpy broadcasting)
+            out[oi] = _merge_dim(out[oi], d)
+    return PartitionSpec(*out)
+
+
+def reduction(input_specs, input_ndims, axis=None, keepdim=False, **attrs):
+    """Reduce over ``axis``: reduced dims lose their sharding (GSPMD inserts
+    the psum/all-reduce); kept dims propagate."""
+    nd = input_ndims[0]
+    dims = _norm(input_specs[0], nd)
+    if axis is None:
+        red = set(range(nd))
+    elif isinstance(axis, (tuple, list)):
+        red = {a % nd for a in axis}
+    else:
+        red = {axis % nd}
+    out = []
+    for i, d in enumerate(dims):
+        if i in red:
+            if keepdim:
+                out.append(None)
+        else:
+            out.append(d)
+    return PartitionSpec(*out)
+
+
+def matmul(input_specs, input_ndims, **attrs):
+    """(…, m, k) × (…, k, n): the contracted dim's sharding is consumed
+    (GSPMD emits the reduce-scatter/all-reduce); m/n shardings propagate."""
+    a, b = _norm(input_specs[0], input_ndims[0]), _norm(input_specs[1],
+                                                       input_ndims[1])
+    batch = a[:-2] if len(a) > 2 else ()
+    return PartitionSpec(*batch, a[-2], b[-1])
+
+
+def replicated(input_specs, input_ndims, **attrs):
+    return PartitionSpec()
+
+
+RULES = {
+    "elementwise": elementwise,
+    "reduction": reduction,
+    "matmul": matmul,
+    "replicated": replicated,
+}
+
+
+def propagate(op_name, input_specs, input_ndims, **attrs):
+    """Predict the output PartitionSpec of ``op_name`` given input specs.
+
+    ``input_specs``: list of PartitionSpec (None = replicated);
+    ``input_ndims``: rank of each input; ``attrs``: op attributes the rule
+    needs (reduction: axis/keepdim).
+    """
+    from ._generated import SPMD_RULES
+    rule = SPMD_RULES.get(op_name)
+    if rule is None:
+        raise KeyError(f"op '{op_name}' has no spmd_rule in ops.yaml")
+    return RULES[rule](list(input_specs), list(input_ndims), **attrs)

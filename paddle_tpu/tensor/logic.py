@@ -4,7 +4,6 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..core.dispatch import apply_op
 from ..core.tensor import Tensor
 
 
@@ -12,37 +11,14 @@ def _t(x):
     return x if isinstance(x, Tensor) else Tensor(x)
 
 
-def _logic(name, fn):
-    def op(x, y=None, out=None, name=None):
-        if y is None:
-            r = Tensor._wrap(fn(_t(x)._data))
-        else:
-            yd = y if isinstance(y, (int, float, bool)) else _t(y)._data
-            r = Tensor._wrap(fn(_t(x)._data, yd))
-        if out is not None:
-            out._data = r._data
-            return out
-        return r
-    op.__name__ = name
-    return op
-
-
-logical_and = _logic("logical_and", jnp.logical_and)
-logical_or = _logic("logical_or", jnp.logical_or)
-logical_xor = _logic("logical_xor", jnp.logical_xor)
-logical_not = _logic("logical_not", jnp.logical_not)
-equal = _logic("equal", jnp.equal)
-not_equal = _logic("not_equal", jnp.not_equal)
-less_than = _logic("less_than", jnp.less)
-less_equal = _logic("less_equal", jnp.less_equal)
-greater_than = _logic("greater_than", jnp.greater)
-greater_equal = _logic("greater_equal", jnp.greater_equal)
-bitwise_and = _logic("bitwise_and", jnp.bitwise_and)
-bitwise_or = _logic("bitwise_or", jnp.bitwise_or)
-bitwise_xor = _logic("bitwise_xor", jnp.bitwise_xor)
-bitwise_not = _logic("bitwise_not", jnp.invert)
-bitwise_left_shift = _logic("bitwise_left_shift", jnp.left_shift)
-bitwise_right_shift = _logic("bitwise_right_shift", jnp.right_shift)
+# Comparison/bitwise ops are YAML-generated (ops/ops.yaml -> ops/_generated.py
+# via scripts/gen_ops.py); re-exported so the public namespace is unchanged.
+from ..ops._generated import (  # noqa: F401
+    bitwise_and, bitwise_left_shift, bitwise_not, bitwise_or,
+    bitwise_right_shift, bitwise_xor, equal, greater_equal, greater_than,
+    less_equal, less_than, logical_and, logical_not, logical_or, logical_xor,
+    not_equal,
+)
 
 
 def is_tensor(x):

@@ -11,10 +11,7 @@ import numpy as np
 from ..core import dtype as dtypes
 from ..core.dispatch import apply_op
 from ..core.tensor import Tensor
-
-
-def _t(x):
-    return x if isinstance(x, Tensor) else Tensor(x)
+from ..ops._runtime import _axis, _t  # noqa: F401  (re-exported for stat.py)
 
 
 def _unary(name, fn):
@@ -25,118 +22,33 @@ def _unary(name, fn):
     return op
 
 
-def _binary(name, fn):
-    def op(x, y, name=None):
-        y = y if isinstance(y, (int, float)) else _t(y)
-        return apply_op(name_, fn, _t(x), y)
-    name_ = name
-    op.__name__ = name
-    return op
-
-
 # -- elementwise unary -------------------------------------------------------
-exp = _unary("exp", jnp.exp)
-expm1 = _unary("expm1", jnp.expm1)
-log = _unary("log", jnp.log)
-log2 = _unary("log2", jnp.log2)
-log10 = _unary("log10", jnp.log10)
-log1p = _unary("log1p", jnp.log1p)
-sqrt = _unary("sqrt", jnp.sqrt)
-rsqrt = _unary("rsqrt", jax.lax.rsqrt)
-square = _unary("square", jnp.square)
-abs = _unary("abs", jnp.abs)
-sign = _unary("sign", jnp.sign)
-ceil = _unary("ceil", jnp.ceil)
-floor = _unary("floor", jnp.floor)
-round = _unary("round", jnp.round)
-trunc = _unary("trunc", jnp.trunc)
-frac = _unary("frac", lambda x: x - jnp.trunc(x))
-sin = _unary("sin", jnp.sin)
-cos = _unary("cos", jnp.cos)
-tan = _unary("tan", jnp.tan)
-asin = _unary("asin", jnp.arcsin)
-acos = _unary("acos", jnp.arccos)
-atan = _unary("atan", jnp.arctan)
-sinh = _unary("sinh", jnp.sinh)
-cosh = _unary("cosh", jnp.cosh)
-tanh = _unary("tanh", jnp.tanh)
-asinh = _unary("asinh", jnp.arcsinh)
-acosh = _unary("acosh", jnp.arccosh)
-atanh = _unary("atanh", jnp.arctanh)
-erf = _unary("erf", jax.lax.erf)
-erfinv = _unary("erfinv", jax.lax.erf_inv)
-sigmoid = _unary("sigmoid", jax.nn.sigmoid)
-reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
-neg = _unary("neg", jnp.negative)
-negative = neg
+# Elementwise unary/binary + reductions are YAML-generated (ops/ops.yaml ->
+# ops/_generated.py via scripts/gen_ops.py, the L3 single-source pipeline);
+# re-exported here so the public namespace is unchanged.
+from ..ops._generated import (  # noqa: F401
+    abs, acos, acosh, add, asin, asinh, atan, atan2, atanh, ceil, clip,
+    copysign, cos, cosh, digamma, divide, divide_no_nan, erf, erfinv, exp,
+    expm1, floor, floor_divide, fmax, fmin, frac, gamma, gcd, heaviside,
+    deg2rad, exponent, hypot, i0, i0e, i1, i1e, isfinite, isinf, isnan, lcm,
+    ldexp, lgamma, log, log1p, log2, log10, logaddexp, logit, maximum,
+    minimum, multiply, nan_to_num, neg, negative, nextafter, pow, rad2deg,
+    reciprocal, remainder, round, rsqrt, scale, sigmoid, sign, sin, sinh,
+    sqrt, square, stanh, subtract, tan, tanh, trunc,
+)
+from ..ops._generated import (  # noqa: F401
+    all, amax, amin, any, count_nonzero, logsumexp, max, mean, min, nanmean,
+    nansum, prod, sum,
+)
+
+mod = remainder
+floor_mod = remainder
+
+# complex-valued ops stay hand-written (no AMP/bf16 parity legs apply)
 conj = _unary("conj", jnp.conj)
 angle = _unary("angle", jnp.angle)
 real = _unary("real", jnp.real)
 imag = _unary("imag", jnp.imag)
-digamma = _unary("digamma", jax.scipy.special.digamma)
-lgamma = _unary("lgamma", jax.scipy.special.gammaln)
-gamma = _unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
-i0 = _unary("i0", jax.scipy.special.i0)
-i0e = _unary("i0e", jax.scipy.special.i0e)
-i1 = _unary("i1", jax.scipy.special.i1)
-i1e = _unary("i1e", jax.scipy.special.i1e)
-isnan = _unary("isnan", jnp.isnan)
-isinf = _unary("isinf", jnp.isinf)
-isfinite = _unary("isfinite", jnp.isfinite)
-logit = _unary("logit", jax.scipy.special.logit)
-nan_to_num = _unary("nan_to_num", jnp.nan_to_num)
-
-
-def deg2rad(x, name=None):
-    return apply_op("deg2rad", jnp.deg2rad, _t(x))
-
-
-def rad2deg(x, name=None):
-    return apply_op("rad2deg", jnp.rad2deg, _t(x))
-
-
-def exponent(x):
-    return apply_op("exponent", lambda v: jnp.floor(jnp.log2(jnp.abs(v))), _t(x))
-
-
-# -- elementwise binary ------------------------------------------------------
-add = _binary("add", jnp.add)
-subtract = _binary("subtract", jnp.subtract)
-multiply = _binary("multiply", jnp.multiply)
-divide = _binary("divide", jnp.true_divide)
-floor_divide = _binary("floor_divide", jnp.floor_divide)
-remainder = _binary("remainder", jnp.remainder)
-mod = remainder
-floor_mod = remainder
-pow = _binary("pow", jnp.power)
-maximum = _binary("maximum", jnp.maximum)
-minimum = _binary("minimum", jnp.minimum)
-fmax = _binary("fmax", jnp.fmax)
-fmin = _binary("fmin", jnp.fmin)
-atan2 = _binary("atan2", jnp.arctan2)
-hypot = _binary("hypot", jnp.hypot)
-logaddexp = _binary("logaddexp", jnp.logaddexp)
-nextafter = _binary("nextafter", jnp.nextafter)
-copysign = _binary("copysign", jnp.copysign)
-heaviside = _binary("heaviside", jnp.heaviside)
-gcd = _binary("gcd", jnp.gcd)
-lcm = _binary("lcm", jnp.lcm)
-ldexp = _binary("ldexp", jnp.ldexp)
-
-
-def divide_no_nan(x, y):
-    return apply_op("divide_no_nan",
-                    lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)),
-                    _t(x), _t(y))
-
-
-def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
-    s = scale.item() if isinstance(scale, Tensor) else scale
-    if bias_after_scale:
-        out = apply_op("scale", lambda v: v * s + bias, _t(x))
-    else:
-        out = apply_op("scale", lambda v: (v + bias) * s, _t(x))
-    return out
 
 
 def multiplex(inputs, index, name=None):
@@ -148,12 +60,6 @@ def multiplex(inputs, index, name=None):
         index, *inputs)
 
 
-def clip(x, min=None, max=None, name=None):
-    mn = min.item() if isinstance(min, Tensor) else min
-    mx = max.item() if isinstance(max, Tensor) else max
-    return apply_op("clip", lambda v: jnp.clip(v, mn, mx), _t(x))
-
-
 def lerp(x, y, weight, name=None):
     if isinstance(weight, Tensor):
         return apply_op("lerp", lambda a, b, w: a + w * (b - a), _t(x), _t(y),
@@ -161,103 +67,7 @@ def lerp(x, y, weight, name=None):
     return apply_op("lerp", lambda a, b: a + weight * (b - a), _t(x), _t(y))
 
 
-def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
-    return apply_op("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), _t(x))
-
-
 # -- reductions --------------------------------------------------------------
-def _axis(axis):
-    if axis is None:
-        return None
-    if isinstance(axis, Tensor):
-        a = axis.numpy()
-        return tuple(int(v) for v in np.atleast_1d(a))
-    if isinstance(axis, (list, tuple)):
-        return tuple(int(a) for a in axis)
-    return int(axis)
-
-
-def sum(x, axis=None, dtype=None, keepdim=False, name=None):
-    ax, dt = _axis(axis), dtypes.convert_dtype(dtype)
-    x = _t(x)
-    if dt is None and dtypes.is_integer(x.dtype) or x.dtype == jnp.bool_:
-        dt = np.dtype(np.int64)
-    return apply_op("sum", lambda v: jnp.sum(v, axis=ax, dtype=dt,
-                                             keepdims=keepdim), x)
-
-
-def mean(x, axis=None, keepdim=False, name=None):
-    ax = _axis(axis)
-    return apply_op("mean", lambda v: jnp.mean(v, axis=ax, keepdims=keepdim),
-                    _t(x))
-
-
-def max(x, axis=None, keepdim=False, name=None):
-    ax = _axis(axis)
-    return apply_op("max", lambda v: jnp.max(v, axis=ax, keepdims=keepdim), _t(x))
-
-
-def min(x, axis=None, keepdim=False, name=None):
-    ax = _axis(axis)
-    return apply_op("min", lambda v: jnp.min(v, axis=ax, keepdims=keepdim), _t(x))
-
-
-def amax(x, axis=None, keepdim=False, name=None):
-    return max(x, axis, keepdim)
-
-
-def amin(x, axis=None, keepdim=False, name=None):
-    return min(x, axis, keepdim)
-
-
-def prod(x, axis=None, keepdim=False, dtype=None, name=None):
-    ax = _axis(axis)
-    dt = dtypes.convert_dtype(dtype)
-    return apply_op("prod", lambda v: jnp.prod(v, axis=ax, dtype=dt,
-                                               keepdims=keepdim), _t(x))
-
-
-def logsumexp(x, axis=None, keepdim=False, name=None):
-    ax = _axis(axis)
-    return apply_op("logsumexp",
-                    lambda v: jax.scipy.special.logsumexp(v, axis=ax,
-                                                          keepdims=keepdim),
-                    _t(x))
-
-
-def log_normalize(x, axis=-1):
-    return apply_op("log_normalize",
-                    lambda v: v - jax.scipy.special.logsumexp(
-                        v, axis=axis, keepdims=True), _t(x))
-
-
-def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
-    ax = _axis(axis)
-    return apply_op("nansum", lambda v: jnp.nansum(v, axis=ax, keepdims=keepdim),
-                    _t(x))
-
-
-def nanmean(x, axis=None, keepdim=False, name=None):
-    ax = _axis(axis)
-    return apply_op("nanmean", lambda v: jnp.nanmean(v, axis=ax, keepdims=keepdim),
-                    _t(x))
-
-
-def count_nonzero(x, axis=None, keepdim=False, name=None):
-    ax = _axis(axis)
-    return Tensor._wrap(jnp.count_nonzero(_t(x)._data, axis=ax, keepdims=keepdim))
-
-
-def all(x, axis=None, keepdim=False, name=None):
-    ax = _axis(axis)
-    return Tensor._wrap(jnp.all(_t(x)._data, axis=ax, keepdims=keepdim))
-
-
-def any(x, axis=None, keepdim=False, name=None):
-    ax = _axis(axis)
-    return Tensor._wrap(jnp.any(_t(x)._data, axis=ax, keepdims=keepdim))
-
-
 # -- cumulative --------------------------------------------------------------
 def cumsum(x, axis=None, dtype=None, name=None):
     x = _t(x)
