@@ -13,6 +13,7 @@ Layer map (SURVEY §2.5-2.6 → TPU):
 
 from . import fleet  # noqa: F401
 from . import utils  # noqa: F401
+from . import ps  # noqa: F401
 from .auto_parallel import (DistAttr, Partial, Placement, ProcessMesh,  # noqa: F401
                             Replicate, Shard, dtensor_from_local,
                             dtensor_to_local, reshard, shard_layer,

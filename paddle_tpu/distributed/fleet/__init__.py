@@ -135,3 +135,37 @@ class PaddleCloudRoleMaker:
 
     def is_server(self):
         return False
+
+
+# -- parameter-server mode (L11) --------------------------------------------
+# reference: fleet/fleet.py init_server():937 / run_server():1038 /
+# init_worker():~900 over the brpc PS runtime; here delegated to the
+# TPU-native host-RAM PS stack in distributed/ps/.
+def is_server():
+    from .. import ps
+    return ps.is_server()
+
+
+def is_worker():
+    from .. import ps
+    return ps.is_worker()
+
+
+def init_server(*args, **kwargs):
+    from .. import ps
+    return ps.init_server(*args, **kwargs)
+
+
+def run_server():
+    from .. import ps
+    return ps.run_server()
+
+
+def init_worker(endpoints=None):
+    from .. import ps
+    return ps.init_worker(endpoints)
+
+
+def stop_worker():
+    from .. import ps
+    return ps.stop_worker()

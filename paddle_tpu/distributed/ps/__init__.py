@@ -1,0 +1,214 @@
+"""Parameter-server training stack (L11).
+
+Reference analogue: the fleet PS mode —
+/root/reference/python/paddle/distributed/fleet/fleet.py init_server()/
+run_server()/init_worker() over the brpc PS runtime
+(paddle/fluid/distributed/ps/), with a_sync and GeoSGD strategies
+(DistributedStrategy.a_sync_configs) and ``paddle.static.nn.sparse_embedding``.
+
+TPU-native redesign: the PS exists for parameters that cannot live in HBM —
+billion-row embedding tables.  Tables live in host RAM on server processes;
+the TPU step only sees the rows pulled for the current batch (a dense
+[unique_ids, dim] block — MXU-friendly), and pushes row gradients back after
+``backward()``.  Dense "geo" replicas push parameter deltas every k steps
+(GeoSGD).  Roles come from the same env contract the reference's
+PaddleCloudRoleMaker reads (TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST /
+PADDLE_PORT).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .client import PSClient
+from .server import ParameterServer
+from .table import DenseTable, SparseTable  # noqa: F401
+
+
+class PSRoleMaker:
+    """Env-var role discovery (reference: PaddleCloudRoleMaker,
+    python/paddle/distributed/fleet/base/role_maker.py)."""
+
+    def __init__(self, role=None, endpoints=None, worker_id=0):
+        self.role = role or os.environ.get("TRAINING_ROLE", "TRAINER").lower()
+        eps = endpoints or os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self.endpoints = ([e for e in eps.split(",") if e]
+                          if isinstance(eps, str) else list(eps))
+        self.worker_id = int(os.environ.get("PADDLE_TRAINER_ID", worker_id))
+        self.server_port = int(os.environ.get("PADDLE_PORT", 0))
+
+    def is_server(self):
+        return self.role == "pserver"
+
+    def is_worker(self):
+        return self.role in ("trainer", "worker")
+
+
+class _PSContext:
+    role_maker: PSRoleMaker | None = None
+    server: ParameterServer | None = None
+    client: PSClient | None = None
+
+
+_CTX = _PSContext()
+
+
+def init(role=None, endpoints=None, worker_id=0):
+    _CTX.role_maker = PSRoleMaker(role, endpoints, worker_id)
+    return _CTX.role_maker
+
+
+def is_server():
+    return _CTX.role_maker is not None and _CTX.role_maker.is_server()
+
+
+def is_worker():
+    return _CTX.role_maker is not None and _CTX.role_maker.is_worker()
+
+
+def init_server(load_dir=None, host="127.0.0.1", port=None):
+    """Create this process's ParameterServer (fleet.init_server; the
+    optional ``load_dir`` mirrors init_server(dirname) incremental
+    training)."""
+    rm = _CTX.role_maker or init(role="pserver")
+    _CTX.server = ParameterServer(
+        host, rm.server_port if port is None else port).start()
+    if load_dir:
+        from .table import load_tables
+        load_tables(_CTX.server.tables, load_dir)
+    return _CTX.server
+
+
+def run_server():
+    """Serve until stop_servers() (fleet.run_server)."""
+    if _CTX.server is None:
+        raise RuntimeError("call init_server() before run_server()")
+    _CTX.server.run()
+
+
+def init_worker(endpoints=None):
+    """Connect this trainer to the server fleet (fleet.init_worker)."""
+    rm = _CTX.role_maker or init()
+    _CTX.client = PSClient(endpoints or rm.endpoints)
+    return _CTX.client
+
+
+def stop_worker():
+    if _CTX.client is not None:
+        _CTX.client.stop_servers()
+        _CTX.client.close()
+        _CTX.client = None
+
+
+def client():
+    if _CTX.client is None:
+        raise RuntimeError("PS worker not initialized — call "
+                           "ps.init_worker(endpoints)")
+    return _CTX.client
+
+
+class SparseEmbedding:
+    """Embedding whose table lives on the parameter servers
+    (reference: python/paddle/static/nn/common.py sparse_embedding -> the
+    distributed lookup-table op).
+
+    forward(): pull the batch's unique rows -> one dense [n_unique, dim]
+    leaf tensor on device -> gather to ids' shape (differentiable).
+    push_step(lr): send d(loss)/d(rows) back; the server applies its own
+    optimizer (apply-on-push, like the reference's sparse accessors).
+    """
+
+    def __init__(self, name, num_embeddings, embedding_dim, ps_client=None,
+                 optimizer="sgd", init_scale=0.01):
+        self.name = name
+        self.dim = int(embedding_dim)
+        self.num = int(num_embeddings)  # advisory; table is open-keyed
+        self._client = ps_client or client()
+        self._client.create_sparse_table(name, self.dim,
+                                         optimizer=optimizer,
+                                         init_scale=init_scale)
+        self._pulled = None
+        self._ids = None
+
+    def __call__(self, ids):
+        return self.forward(ids)
+
+    def forward(self, ids):
+        import paddle_tpu as paddle
+        ids_np = np.asarray(ids.numpy() if hasattr(ids, "numpy") else ids,
+                            np.int64)
+        uniq, inv = np.unique(ids_np.reshape(-1), return_inverse=True)
+        rows = self._client.pull_sparse(self.name, uniq)
+        pulled = paddle.to_tensor(rows)
+        pulled.stop_gradient = False
+        self._pulled, self._ids = pulled, uniq
+        out = paddle.gather(pulled, paddle.to_tensor(inv.astype(np.int32)))
+        return out.reshape(list(ids_np.shape) + [self.dim])
+
+    def push_step(self, lr):
+        """After loss.backward(): push the pulled rows' grads to the PS."""
+        if self._pulled is None or self._pulled.grad is None:
+            return
+        self._client.push_sparse(self.name, self._ids,
+                                 self._pulled.grad.numpy(), lr)
+        self._pulled = self._ids = None
+
+
+class GeoTrainer:
+    """GeoSGD for dense parameters (reference: GeoCommunicator,
+    paddle/fluid/distributed/ps/service/communicator/communicator.h — local
+    SGD, push param-deltas every k steps, pull the merged global params).
+
+    Wraps a list of paddle parameters; call step() once per optimizer step.
+    """
+
+    def __init__(self, table_prefix, parameters, k_steps=4, ps_client=None):
+        import paddle_tpu as paddle
+        self._client = ps_client or client()
+        self._params = list(parameters)
+        self._k = int(k_steps)
+        self._step = 0
+        self._names = []
+        self._base = []
+        for i, p in enumerate(self._params):
+            name = f"{table_prefix}.{i}"
+            self._names.append(name)
+            self._client.create_dense_table(name, tuple(p.shape))
+            # first worker's init wins atomically (server-side init_once);
+            # every worker then starts from the settled server value
+            self._client.dense_init_once(name, p.numpy())
+            server_val = self._client.pull_dense(name)
+            with paddle.no_grad():
+                p.set_value(paddle.to_tensor(server_val))
+            self._base.append(server_val.copy())
+
+    def step(self):
+        """Call after optimizer.step(); every k-th call syncs with the PS."""
+        self._step += 1
+        if self._step % self._k:
+            return False
+        self.sync()
+        return True
+
+    def sync(self):
+        """Push local deltas, pull the merged global params (the
+        communicator's flush; also call once at the end of training so all
+        workers converge to the same global state)."""
+        import paddle_tpu as paddle
+        for p, name, base in zip(self._params, self._names, self._base):
+            cur = p.numpy().astype(np.float32)
+            self._client.push_dense_delta(name, cur - base)
+            new = self._client.pull_dense(name)
+            with paddle.no_grad():
+                p.set_value(paddle.to_tensor(new))
+        self._base = [p.numpy().astype(np.float32).copy()
+                      for p in self._params]
+
+
+__all__ = [
+    "PSClient", "ParameterServer", "PSRoleMaker", "SparseEmbedding",
+    "GeoTrainer", "init", "is_server", "is_worker", "init_server",
+    "run_server", "init_worker", "stop_worker", "client",
+]

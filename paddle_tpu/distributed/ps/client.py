@@ -1,0 +1,111 @@
+"""PS client: routes pulls/pushes to the server shard owning each id.
+
+Reference analogue: BrpcPsClient
+(/root/reference/paddle/fluid/distributed/ps/service/brpc_ps_client.cc) —
+sparse keys are sharded over servers; dense tables live on shard 0 here
+(the reference splits dense blocks across servers too; with host-RAM tables
+that buys nothing until tables exceed one host).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .rpc import RpcClient
+
+
+class PSClient:
+    def __init__(self, endpoints):
+        if isinstance(endpoints, str):
+            endpoints = [e for e in endpoints.split(",") if e]
+        self._conns = []
+        for ep in endpoints:
+            host, port = ep.rsplit(":", 1)
+            self._conns.append(RpcClient(host, int(port)))
+
+    @property
+    def num_servers(self):
+        return len(self._conns)
+
+    # -- table creation (broadcast so every shard knows the schema) ---------
+    def create_sparse_table(self, table, dim, **kw):
+        seed = kw.pop("seed", 0)
+        for i, c in enumerate(self._conns):
+            c.call(op="create_sparse", table=table, dim=dim, seed=seed + i,
+                   **kw)
+
+    def create_dense_table(self, table, shape, **kw):
+        self._conns[0].call(op="create_dense", table=table,
+                            shape=list(shape), **kw)
+
+    # -- sparse -------------------------------------------------------------
+    def _shard(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        owner = ids % self.num_servers
+        return ids, owner
+
+    def pull_sparse(self, table, ids):
+        ids, owner = self._shard(ids)
+        parts = {}
+        for s in range(self.num_servers):
+            mask = owner == s
+            if mask.any():
+                parts[s] = (mask, self._conns[s].call(
+                    op="pull_sparse", table=table,
+                    ids=ids[mask])["values"])
+        dim = next(iter(parts.values()))[1].shape[1] if parts else 0
+        out = np.zeros((ids.size, dim), np.float32)
+        for mask, vals in parts.values():
+            out[mask] = vals
+        return out
+
+    def push_sparse(self, table, ids, grads, lr):
+        ids, owner = self._shard(ids)
+        grads = np.asarray(grads, np.float32).reshape(ids.size, -1)
+        for s in range(self.num_servers):
+            mask = owner == s
+            if mask.any():
+                self._conns[s].call(op="push_sparse", table=table,
+                                    ids=ids[mask], grads=grads[mask], lr=lr)
+
+    def sparse_table_size(self, table):
+        return sum(c.call(op="table_size", table=table)["size"]
+                   for c in self._conns)
+
+    # -- dense --------------------------------------------------------------
+    def pull_dense(self, table):
+        return self._conns[0].call(op="pull_dense", table=table)["value"]
+
+    def push_dense_grad(self, table, grad, lr):
+        self._conns[0].call(op="push_dense_grad", table=table,
+                            grad=np.asarray(grad, np.float32), lr=lr)
+
+    def push_dense_delta(self, table, delta):
+        self._conns[0].call(op="push_dense_delta", table=table,
+                            delta=np.asarray(delta, np.float32))
+
+    def dense_init_once(self, table, value):
+        """Atomic first-writer-wins seeding (GeoTrainer startup)."""
+        return self._conns[0].call(op="dense_init_once", table=table,
+                                   value=np.asarray(value,
+                                                    np.float32))["seeded"]
+
+    # -- lifecycle ----------------------------------------------------------
+    def save(self, dirname):
+        for i, c in enumerate(self._conns):
+            c.call(op="save", dirname=f"{dirname}/shard{i}")
+
+    def load(self, dirname):
+        for i, c in enumerate(self._conns):
+            c.call(op="load", dirname=f"{dirname}/shard{i}")
+
+    def stop_servers(self):
+        for c in self._conns:
+            try:
+                c.call(op="stop")
+            except (RuntimeError, ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for c in self._conns:
+            c.close()

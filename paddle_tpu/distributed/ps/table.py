@@ -1,0 +1,188 @@
+"""Parameter-server tables (L11).
+
+Reference analogue: the brpc PS table family —
+/root/reference/paddle/fluid/distributed/ps/table/memory_sparse_table.cc
+(hash-bucketed lazily-created embedding rows with an optimizer fused into
+push) and common_dense_table (dense slices).  TPU-native role: tables live in
+HOST memory (they are exactly the parameters too large for 15.75G HBM —
+billion-row embeddings); the TPU holds only the rows pulled for the current
+batch.  Apply-on-push keeps the optimizer state host-side too.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+
+class _SGD:
+    name = "sgd"
+
+    def apply(self, state, value, grad, lr):
+        value -= lr * grad
+        return value
+
+
+class _Adagrad:
+    """Per-row adagrad (the reference's sparse accessor default family)."""
+
+    name = "adagrad"
+
+    def __init__(self, eps=1e-8):
+        self.eps = eps
+
+    def apply(self, state, value, grad, lr):
+        g2 = state.setdefault("g2", np.zeros_like(value))
+        g2 += grad * grad
+        value -= lr * grad / (np.sqrt(g2) + self.eps)
+        return value
+
+
+_OPTIMIZERS = {"sgd": _SGD, "adagrad": _Adagrad}
+
+
+def make_optimizer(name):
+    try:
+        return _OPTIMIZERS[name]()
+    except KeyError:
+        raise ValueError(f"unknown PS table optimizer '{name}' "
+                         f"(have {sorted(_OPTIMIZERS)})") from None
+
+
+class SparseTable:
+    """id -> embedding row, rows created lazily on first pull (the
+    reference's MemorySparseTable semantics: unseen ids initialize from the
+    initializer, `entry` thresholds omitted)."""
+
+    def __init__(self, name, dim, initializer="normal", init_scale=0.01,
+                 optimizer="sgd", seed=0):
+        self.name = name
+        self.dim = int(dim)
+        self.init_scale = float(init_scale)
+        self.initializer = initializer
+        self.optimizer = make_optimizer(optimizer)
+        self._rows: dict[int, np.ndarray] = {}
+        self._state: dict[int, dict] = {}
+        self._rng = np.random.RandomState(seed ^ (hash(name) & 0x7FFFFFFF))
+        self._lock = threading.Lock()
+
+    def _init_row(self):
+        if self.initializer == "zeros":
+            return np.zeros(self.dim, np.float32)
+        return (self._rng.standard_normal(self.dim) *
+                self.init_scale).astype(np.float32)
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.empty((ids.size, self.dim), np.float32)
+        with self._lock:
+            for i, v in enumerate(ids):
+                row = self._rows.get(int(v))
+                if row is None:
+                    row = self._rows[int(v)] = self._init_row()
+                out[i] = row
+        return out
+
+    def push(self, ids, grads, lr):
+        """Apply optimizer update for (possibly repeated) ids: repeated ids'
+        gradients accumulate first, matching dense embedding backward."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(ids.size, self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(acc, inv, grads)
+        with self._lock:
+            for i, v in enumerate(uniq):
+                key = int(v)
+                row = self._rows.get(key)
+                if row is None:
+                    row = self._rows[key] = self._init_row()
+                st = self._state.setdefault(key, {})
+                self._rows[key] = self.optimizer.apply(st, row, acc[i], lr)
+
+    def __len__(self):
+        return len(self._rows)
+
+    def save(self, path):
+        with self._lock:
+            ids = np.fromiter(self._rows.keys(), np.int64,
+                              count=len(self._rows))
+            vals = (np.stack(list(self._rows.values()))
+                    if self._rows else np.zeros((0, self.dim), np.float32))
+        np.savez(path, ids=ids, values=vals, dim=self.dim)
+
+    def load(self, path):
+        data = np.load(path)
+        with self._lock:
+            self._rows = {int(i): v.copy()
+                          for i, v in zip(data["ids"], data["values"])}
+            self._state.clear()
+
+
+class DenseTable:
+    """Flat dense parameter block with add-delta (GeoSGD) and
+    apply-gradient (a_sync) push modes."""
+
+    def __init__(self, name, shape, initializer="zeros", init_scale=0.01,
+                 optimizer="sgd", seed=0):
+        self.name = name
+        self.shape = tuple(int(s) for s in shape)
+        if initializer == "zeros":
+            self.value = np.zeros(self.shape, np.float32)
+        else:
+            rng = np.random.RandomState(seed ^ (hash(name) & 0x7FFFFFFF))
+            self.value = (rng.standard_normal(self.shape) *
+                          init_scale).astype(np.float32)
+        self.optimizer = make_optimizer(optimizer)
+        self._state: dict = {}
+        self._seeded = False
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self.value.copy()
+
+    def init_once(self, value):
+        """Atomically seed the table with the first caller's value; later
+        callers are no-ops.  Removes the pull-check-push race when N workers
+        construct GeoTrainer concurrently."""
+        with self._lock:
+            if self._seeded:
+                return False
+            self.value = np.asarray(value, np.float32).reshape(self.shape)
+            self._seeded = True
+            return True
+
+    def push_grad(self, grad, lr):
+        with self._lock:
+            self.value = self.optimizer.apply(
+                self._state, self.value, np.asarray(grad, np.float32), lr)
+
+    def push_delta(self, delta):
+        """GeoSGD: server just accumulates trainer deltas
+        (reference: paddle/fluid/distributed/ps/service/communicator —
+        GeoCommunicator push of param diffs)."""
+        with self._lock:
+            self.value += np.asarray(delta, np.float32)
+
+    def save(self, path):
+        np.savez(path, value=self.pull())
+
+    def load(self, path):
+        with self._lock:
+            self.value = np.load(path)["value"].astype(np.float32)
+
+
+def save_tables(tables, dirname):
+    os.makedirs(dirname, exist_ok=True)
+    for t in tables.values():
+        t.save(os.path.join(dirname, f"{t.name}.npz"))
+
+
+def load_tables(tables, dirname):
+    for t in tables.values():
+        p = os.path.join(dirname, f"{t.name}.npz")
+        if os.path.exists(p):
+            t.load(p)

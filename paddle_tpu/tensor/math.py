@@ -68,6 +68,12 @@ def lerp(x, y, weight, name=None):
 
 
 # -- reductions --------------------------------------------------------------
+def log_normalize(x, axis=-1):
+    return apply_op("log_normalize",
+                    lambda v: v - jax.scipy.special.logsumexp(
+                        v, axis=axis, keepdims=True), _t(x))
+
+
 # -- cumulative --------------------------------------------------------------
 def cumsum(x, axis=None, dtype=None, name=None):
     x = _t(x)

@@ -1,0 +1,268 @@
+"""Parameter-server stack (L11) tests.
+
+Reference analogue: test/legacy_test/test_dist_fleet_ps*.py — PS training
+with sparse_embedding tables and geo/a_sync strategies.  Here servers run
+in-process (threaded rpc loop) so the full pull/train/push cycle is
+exercised without process orchestration.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import (GeoTrainer, ParameterServer, PSClient,
+                                       SparseEmbedding)
+
+
+@pytest.fixture
+def server():
+    s = ParameterServer(port=0).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def two_servers():
+    ss = [ParameterServer(port=0).start() for _ in range(2)]
+    yield ss
+    for s in ss:
+        s.stop()
+
+
+def test_sparse_pull_push_roundtrip(server):
+    c = PSClient([server.endpoint])
+    c.create_sparse_table("emb", 4, initializer="zeros")
+    ids = np.array([3, 7, 3])
+    vals = c.pull_sparse("emb", ids)
+    assert vals.shape == (3, 4)
+    np.testing.assert_array_equal(vals, 0)
+    # push grad 1.0 on id 3 twice and id 7 once, lr=0.1 (sgd apply-on-push)
+    c.push_sparse("emb", ids, np.ones((3, 4), np.float32), lr=0.1)
+    after = c.pull_sparse("emb", np.array([3, 7]))
+    np.testing.assert_allclose(after[0], -0.2, rtol=1e-6)  # 2 grads summed
+    np.testing.assert_allclose(after[1], -0.1, rtol=1e-6)
+    c.close()
+
+
+def test_dense_grad_and_delta(server):
+    c = PSClient([server.endpoint])
+    c.create_dense_table("w", (2, 3))
+    np.testing.assert_array_equal(c.pull_dense("w"), 0)
+    c.push_dense_grad("w", np.ones((2, 3), np.float32), lr=0.5)
+    np.testing.assert_allclose(c.pull_dense("w"), -0.5)
+    c.push_dense_delta("w", np.full((2, 3), 0.5, np.float32))
+    np.testing.assert_allclose(c.pull_dense("w"), 0.0)
+    c.close()
+
+
+def test_sharded_sparse_routing(two_servers):
+    """ids shard by id % num_servers; every id must round-trip through its
+    owner only."""
+    c = PSClient([s.endpoint for s in two_servers])
+    c.create_sparse_table("emb", 2, initializer="zeros")
+    ids = np.arange(10)
+    c.push_sparse("emb", ids, np.ones((10, 2), np.float32), lr=1.0)
+    # evens on server 0, odds on server 1
+    assert len(two_servers[0].tables["emb"]) == 5
+    assert len(two_servers[1].tables["emb"]) == 5
+    vals = c.pull_sparse("emb", ids)
+    np.testing.assert_allclose(vals, -1.0)
+    assert c.sparse_table_size("emb") == 10
+    c.close()
+
+
+def test_sparse_embedding_trains_vs_dense_twin(server):
+    """The PS-backed embedding must follow the same trajectory as an
+    in-process dense embedding trained with plain SGD (loss parity — the
+    BASELINE.md criterion for PS configs)."""
+    rng = np.random.RandomState(0)
+    V, D, B = 20, 8, 16
+    table0 = rng.standard_normal((V, D)).astype(np.float32) * 0.1
+    targets = rng.standard_normal((B, D)).astype(np.float32)
+    ids_np = rng.randint(0, V, size=(B,))
+
+    # dense twin (numpy reference)
+    w = table0.copy()
+    ref_losses = []
+    for _ in range(5):
+        e = w[ids_np]
+        diff = e - targets
+        ref_losses.append(float((diff ** 2).mean()))
+        g = np.zeros_like(w)
+        np.add.at(g, ids_np, 2.0 * diff / diff.size)
+        w -= 0.5 * g
+
+    # PS path
+    c = PSClient([server.endpoint])
+    emb = SparseEmbedding("emb", V, D, ps_client=c, optimizer="sgd")
+    # seed table with identical init
+    c.push_sparse("emb", np.arange(V),
+                  -(table0 - c.pull_sparse("emb", np.arange(V))), lr=1.0)
+    np.testing.assert_allclose(c.pull_sparse("emb", np.arange(V)), table0,
+                               atol=1e-6)
+    ids = paddle.to_tensor(ids_np.astype(np.int64))
+    tgt = paddle.to_tensor(targets)
+    ps_losses = []
+    for _ in range(5):
+        out = emb(ids)
+        loss = ((out - tgt) ** 2).mean()
+        loss.backward()
+        ps_losses.append(float(loss.numpy()))
+        emb.push_step(lr=0.5)
+    np.testing.assert_allclose(ps_losses, ref_losses, rtol=1e-4)
+    assert ps_losses[-1] < ps_losses[0]
+    c.close()
+
+
+def test_geo_trainer_syncs_every_k(server):
+    c = PSClient([server.endpoint])
+    lin = paddle.nn.Linear(4, 4)
+    geo = GeoTrainer("geo_lin", lin.parameters(), k_steps=3, ps_client=c)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .standard_normal((8, 4)).astype(np.float32))
+    synced = []
+    for step in range(6):
+        loss = (lin(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        synced.append(geo.step())
+    assert synced == [False, False, True, False, False, True]
+    # after a sync, server table == worker param
+    np.testing.assert_allclose(c.pull_dense("geo_lin.0"),
+                               lin.parameters()[0].numpy(), atol=1e-6)
+    c.close()
+
+
+def test_geo_two_workers_converge(server):
+    """Two geo workers sharing one PS: both push deltas; both end up with
+    the merged global params and a decreasing loss."""
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.standard_normal((16, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((16, 2)).astype(np.float32))
+
+    workers = []
+    for _ in range(2):
+        c = PSClient([server.endpoint])
+        lin = paddle.nn.Linear(4, 2)
+        geo = GeoTrainer("geo2", lin.parameters(), k_steps=2, ps_client=c)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=lin.parameters())
+        workers.append((c, lin, geo, opt))
+
+    first = last = None
+    for step in range(8):
+        for c, lin, geo, opt in workers:
+            loss = ((lin(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            geo.step()
+            val = float(loss.numpy())
+            first = val if first is None else first
+            last = val
+    assert last < first
+    # final flush: everyone pushes outstanding deltas, then everyone pulls
+    # the settled global state (the communicator's end-of-training barrier)
+    for _, _, geo, _ in workers:
+        geo.sync()
+    for _, _, geo, _ in workers:
+        geo.sync()
+    w0 = workers[0][1].parameters()[0].numpy()
+    w1 = workers[1][1].parameters()[0].numpy()
+    np.testing.assert_allclose(w0, w1, atol=1e-5)
+    for c, *_ in workers:
+        c.close()
+
+
+def test_save_load_roundtrip(server, tmp_path):
+    c = PSClient([server.endpoint])
+    c.create_sparse_table("emb", 3)
+    c.create_dense_table("w", (2, 2))
+    ids = np.array([1, 5, 9])
+    before = c.pull_sparse("emb", ids)
+    c.push_dense_grad("w", np.ones((2, 2), np.float32), lr=1.0)
+    c.save(str(tmp_path))
+    # clobber, then restore
+    c.push_sparse("emb", ids, np.ones((3, 3), np.float32), lr=10.0)
+    c.push_dense_delta("w", np.ones((2, 2), np.float32))
+    c.load(str(tmp_path))
+    np.testing.assert_allclose(c.pull_sparse("emb", ids), before, atol=1e-7)
+    np.testing.assert_allclose(c.pull_dense("w"), -1.0)
+    c.close()
+
+
+def test_fleet_ps_roles_and_lifecycle():
+    """fleet.init_server/run_server/init_worker/stop_worker wiring
+    (reference: fleet.py:937,1038)."""
+    import threading
+
+    from paddle_tpu.distributed import fleet, ps
+
+    ps.init(role="pserver")
+    assert fleet.is_server() and not fleet.is_worker()
+    server = fleet.init_server()
+    t = threading.Thread(target=fleet.run_server, daemon=True)
+    t.start()
+
+    ps.init(role="trainer")
+    assert fleet.is_worker()
+    fleet.init_worker(endpoints=[server.endpoint])
+    ps.client().create_sparse_table("e", 2)
+    assert ps.client().pull_sparse("e", np.array([0])).shape == (1, 2)
+    fleet.stop_worker()  # stops the server too
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_dense_init_once_is_atomic(server):
+    """N concurrent first-writers: exactly one seeds the table (GeoTrainer
+    startup race)."""
+    import threading
+
+    c = PSClient([server.endpoint])
+    c.create_dense_table("seed_t", (4,))
+    results = []
+    lock = threading.Lock()
+
+    def worker(i):
+        cc = PSClient([server.endpoint])
+        won = cc.dense_init_once("seed_t", np.full(4, float(i + 1),
+                                                   np.float32))
+        with lock:
+            results.append((i, won))
+        cc.close()
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    winners = [i for i, won in results if won]
+    assert len(winners) == 1
+    np.testing.assert_allclose(c.pull_dense("seed_t"),
+                               float(winners[0] + 1))
+    c.close()
+
+
+def test_rpc_many_arrays_roundtrip():
+    """>10 arrays in one message must not scramble (wire order is numeric,
+    not lexicographic)."""
+    from paddle_tpu.distributed.ps.rpc import _encode, _decode
+
+    import io
+    import socket as socket_mod
+
+    msg = {"arrs": [np.full((2, 2), i, np.float32) for i in range(13)]}
+    raw = _encode(msg)
+
+    class FakeSock:
+        def __init__(self, buf):
+            self._b = io.BytesIO(buf)
+
+        def recv(self, n):
+            return self._b.read(n)
+
+    out = _decode(FakeSock(raw))
+    for i, a in enumerate(out["arrs"]):
+        np.testing.assert_array_equal(a, np.full((2, 2), i, np.float32))
