@@ -90,7 +90,9 @@ def apply_op(name, fn, *args, nout=1, amp=True, **kwargs):
             datas = _amp_cast(name, datas)
         rebuilt = jax.tree_util.tree_unflatten(treedef, datas)
         out = fn(*rebuilt, **kwargs)
-        return _wrap_outputs(name, out, None, nout)
+        wrapped = _wrap_outputs(name, out, None, nout)
+        _maybe_record(name, fn, treedef, leaves, kwargs, wrapped)
+        return wrapped
 
     def closure(*dvals):
         ds = list(datas)
@@ -109,8 +111,21 @@ def apply_op(name, fn, *args, nout=1, amp=True, **kwargs):
     parents = [leaves[p] for p in diff_pos]
     node = GradNode(name, vjp_fn, parents,
                     [(o.shape, o.dtype) for o in outs])
-    return _wrap_outputs(name, outs if nout != 1 or len(outs) > 1 else outs[0],
-                         node, nout)
+    wrapped = _wrap_outputs(name,
+                            outs if nout != 1 or len(outs) > 1 else outs[0],
+                            node, nout)
+    _maybe_record(name, fn, treedef, leaves, kwargs, wrapped)
+    return wrapped
+
+
+def _maybe_record(name, fn, treedef, leaves, kwargs, outputs):
+    """paddle.static program capture: while a Program is under
+    ``program_guard``, every dispatched op is appended to its op list (the
+    analogue of static-mode op registration into the current Block,
+    reference: python/paddle/base/framework.py append_op)."""
+    prog = STATE.recording_program
+    if prog is not None and STATE.tracing_depth == 0:
+        prog._record(name, fn, treedef, leaves, kwargs, outputs)
 
 
 def _wrap_outputs(name, out, node, nout):

@@ -20,6 +20,7 @@ class _State(threading.local):
         self.amp_white = set()
         self.amp_black = set()
         self.tracing_depth = 0         # >0 while inside jax.jit trace
+        self.recording_program = None  # paddle.static Program under guard
 
 
 STATE = _State()

@@ -31,13 +31,37 @@ def _wrap_logic(fn, x, y=None, out=None):
     """Comparison/bitwise ops: no autograd tape (discrete outputs), but the
     same Tensor-in/Tensor-out contract.  Mirrors the reference's logic ops,
     which register no grad kernels (phi/ops/yaml/ops.yaml has no
-    equal_grad/bitwise_and_grad entries)."""
+    equal_grad/bitwise_and_grad entries).  Still records into a
+    paddle.static Program so comparisons are replayed, not baked in."""
+    from ..core.state import STATE
+    if STATE.recording_program is None:  # common eager path: no bookkeeping
+        if y is None:
+            r = Tensor._wrap(fn(_t(x)._data))
+        else:
+            yd = y if isinstance(y, (int, float, bool)) else _t(y)._data
+            r = Tensor._wrap(fn(_t(x)._data, yd))
+        if out is not None:
+            out._data = r._data
+            return out
+        return r
+
+    import jax.tree_util as jtu
+
+    from ..core.dispatch import _maybe_record
+
     if y is None:
-        r = Tensor._wrap(fn(_t(x)._data))
+        leaves = [_t(x)]
+        r = Tensor._wrap(fn(leaves[0]._data))
     else:
-        yd = y if isinstance(y, (int, float, bool)) else _t(y)._data
-        r = Tensor._wrap(fn(_t(x)._data, yd))
+        yt = y if isinstance(y, (int, float, bool)) else _t(y)
+        leaves = [_t(x), yt]
+        yd = yt._data if isinstance(yt, Tensor) else yt
+        r = Tensor._wrap(fn(leaves[0]._data, yd))
     if out is not None:
         out._data = r._data
-        return out
+        r = out
+    treedef = jtu.tree_structure(tuple(leaves),
+                                 is_leaf=lambda v: isinstance(v, Tensor))
+    _maybe_record(getattr(fn, "__name__", "logic"), fn, treedef, leaves, {},
+                  r)
     return r

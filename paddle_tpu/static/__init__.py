@@ -1,15 +1,30 @@
-"""paddle.static compat layer (reference: python/paddle/static/).
+"""paddle.static: the static-graph twin (Program / Executor / program_guard).
 
-TPU-native: there is no second graph IR — "static graph" IS jax.jit tracing
-(see paddle_tpu.jit).  This module keeps the Program/Executor API shape for
-user code portability: a Program records a python callable; Executor.run jits
-and runs it."""
+Reference analogue: python/paddle/static/ over the PIR program +
+StandaloneExecutor (/root/reference/paddle/fluid/framework/new_executor/
+standalone_executor.h:34): user code under ``program_guard`` appends one op
+per API call into the current Block; ``Executor.run`` feeds placeholders,
+executes the program, fetches results.
+
+TPU-native redesign: there is no second IR to maintain — the "program" is a
+recorded list of the very same traceable kernels eager mode dispatches
+(core/dispatch.py appends each op while a Program is under guard), and
+``Executor.run`` replays that list inside ONE ``jax.jit`` so XLA sees the
+whole program and fuses it exactly like the jit path (compiled per
+feed-shape signature, like the reference's shape-specialised kernels).
+``gradients``/``append_backward`` differentiate the replay with ``jax.grad``
+instead of building reverse ops into the program.
+"""
 
 from __future__ import annotations
 
+import itertools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from ..core.state import STATE
 from ..core.tensor import Tensor
 
 
@@ -28,27 +43,382 @@ class InputSpec:
         return cls(tensor.shape, str(tensor.dtype), name)
 
 
+class _Node:
+    __slots__ = ("name", "fn", "treedef", "leaf_keys", "kwargs", "out_keys")
+
+    def __init__(self, name, fn, treedef, leaf_keys, kwargs, out_keys):
+        self.name = name
+        self.fn = fn
+        self.treedef = treedef
+        self.leaf_keys = leaf_keys   # ('var', vid) | ('const', value)
+        self.kwargs = kwargs
+        self.out_keys = out_keys
+
+
 class Program:
+    """Recorded op list + variable environment (the Block/ProgramDesc
+    analogue; one implicit global block)."""
+
     def __init__(self):
-        self._fn = None
+        self._nodes: list[_Node] = []
+        self._externals: dict[int, Tensor] = {}  # params/captured tensors
+        self._feeds: dict[str, int] = {}         # data() name -> vid
+        self._feed_shapes: dict[str, tuple] = {}
+        self._next_vid = itertools.count()
+        self._compile_cache: dict = {}
+        self._keepalive: list = []               # layers created via nn.fc
+        self._origin = self   # shared vid namespace across clone()s
+
+    # -- recording (called from core.dispatch._maybe_record) ---------------
+    def _vid_of(self, t, create_external=True):
+        ref = getattr(t, "_prog_ref", None)
+        if ref is not None and ref[0]._origin is self._origin:
+            return ref[1]
+        if not create_external:
+            return None
+        vid = next(self._next_vid)
+        t._prog_ref = (self, vid)
+        self._externals[vid] = t  # parameter/constant input: resolved live
+        return vid
+
+    def _record(self, name, fn, treedef, leaves, kwargs, outputs):
+        leaf_keys = []
+        for leaf in leaves:
+            if isinstance(leaf, Tensor):
+                leaf_keys.append(("var", self._vid_of(leaf)))
+            else:
+                leaf_keys.append(("const", leaf))
+        outs = outputs if isinstance(outputs, tuple) else (outputs,)
+        out_keys = []
+        for t in outs:
+            vid = next(self._next_vid)
+            t._prog_ref = (self, vid)
+            out_keys.append(vid)
+        self._nodes.append(_Node(name, fn, treedef, leaf_keys, dict(kwargs),
+                                 out_keys))
+        self._compile_cache.clear()
+
+    def _add_feed(self, name, shape, dtype):
+        placeholder_shape = tuple(1 if (s is None or s < 0) else int(s)
+                                  for s in (shape or ()))
+        t = Tensor._wrap(jnp.zeros(placeholder_shape, dtype))
+        vid = next(self._next_vid)
+        t._prog_ref = (self, vid)
+        self._feeds[name] = vid
+        self._feed_shapes[name] = tuple(shape or ())
+        return t
+
+    # -- replay -------------------------------------------------------------
+    def _run_nodes(self, env, override_vid=None, override_val=None):
+        """Replay the op list.  With an override, the given vid takes
+        ``override_val`` INSTEAD of its producer's output (and instead of
+        its env0 entry), which is what differentiating w.r.t. an
+        intermediate variable means: downstream consumers see the override,
+        the producer's value for it is discarded."""
+        if override_vid is not None:
+            env[override_vid] = override_val
+        for node in self._nodes:
+            datas = [env[k] if kind == "var" else k
+                     for kind, k in node.leaf_keys]
+            rebuilt = jax.tree_util.tree_unflatten(node.treedef, datas)
+            out = node.fn(*rebuilt, **node.kwargs)
+            outs = out if isinstance(out, tuple) else (out,)
+            for vid, o in zip(node.out_keys, outs):
+                if vid != override_vid:
+                    env[vid] = o
+        return env
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def ops(self):
+        return [n.name for n in self._nodes]
 
     def global_block(self):
         return self
 
-    def clone(self, for_test=False):
+    def block(self, i=0):
         return self
+
+    def clone(self, for_test=False):
+        p = Program()
+        p._nodes = list(self._nodes)
+        p._externals = dict(self._externals)
+        p._feeds = dict(self._feeds)
+        p._feed_shapes = dict(self._feed_shapes)
+        # clones share the origin's vid namespace, so variables recorded in
+        # either remain fetchable from both and new vids never collide
+        p._origin = self._origin
+        p._next_vid = self._origin._next_vid
+        return p
+
+    def to_string(self):
+        from ..ops import SPMD_RULES
+        lines = []
+        feed_names = {v: k for k, v in self._feeds.items()}
+        for vid, name in sorted(feed_names.items()):
+            lines.append(f"%{vid} = feed[{name!r}] "
+                         f"shape={self._feed_shapes[name]}")
+        for vid, t in sorted(self._externals.items()):
+            lines.append(f"%{vid} = param shape={tuple(t.shape)} "
+                         f"dtype={t.dtype}")
+        for n in self._nodes:
+            ins = ", ".join(f"%{k}" if kind == "var" else repr(k)
+                            for kind, k in n.leaf_keys)
+            outs = ", ".join(f"%{k}" for k in n.out_keys)
+            attrs = f" {n.kwargs}" if n.kwargs else ""
+            rule = SPMD_RULES.get(n.name)
+            spmd = f"  [spmd: {rule}]" if rule else ""
+            lines.append(f"{outs} = {n.name}({ins}){attrs}{spmd}")
+        return "\n".join(lines)
+
+    __str__ = to_string
+    __repr__ = to_string
+
+
+class _GradVar:
+    """Marker returned by gradients()/append_backward(): fetchable handle
+    for d(sum of targets)/d(wrt)."""
+
+    def __init__(self, program, target_vids, wrt_vid, name):
+        self.program = program
+        self.target_vids = tuple(target_vids)
+        self.wrt_vid = wrt_vid
+        self.name = name
+
+
+_DEFAULT_MAIN = Program()
+_DEFAULT_STARTUP = Program()
 
 
 def default_main_program():
-    return Program()
+    return _DEFAULT_MAIN
 
 
 def default_startup_program():
-    return Program()
+    return _DEFAULT_STARTUP
 
 
 class program_guard:
+    """Route op recording into ``main_program`` (reference:
+    python/paddle/base/framework.py program_guard)."""
+
     def __init__(self, main_program=None, startup_program=None):
+        self.main = main_program if main_program is not None else Program()
+        self.startup = startup_program
+
+    def __enter__(self):
+        self._prev = STATE.recording_program
+        STATE.recording_program = self.main
+        return self
+
+    def __exit__(self, *a):
+        STATE.recording_program = self._prev
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed placeholder in the current program (reference:
+    python/paddle/static/input.py data)."""
+    prog = STATE.recording_program
+    if prog is None:
+        return InputSpec(shape, dtype, name)
+    return prog._add_feed(name, shape, dtype)
+
+
+def gradients(targets, inputs, target_gradients=None):
+    """d(sum over all targets)/d(inputs) as fetchable handles (reference:
+    python/paddle/base/backward.py gradients)."""
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "gradients(target_gradients=...) custom cotangents are not "
+            "supported; compose the weighting into the target expression")
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    prog = targets[0]._prog_ref[0]
+    t_vids = []
+    for t in targets:
+        ref = getattr(t, "_prog_ref", None)
+        if ref is None or ref[0]._origin is not prog._origin:
+            raise ValueError("gradients(): targets belong to different "
+                             "programs")
+        t_vids.append(ref[1])
+    out = []
+    for w in inputs:
+        ref = getattr(w, "_prog_ref", None)
+        if ref is None or ref[0]._origin is not prog._origin:
+            raise ValueError("gradients(): input is not a variable of the "
+                             "target's program")
+        out.append(_GradVar(prog, t_vids, ref[1], f"grad_{ref[1]}"))
+    return out
+
+
+def append_backward(loss, parameter_list=None):
+    """Classic static API: returns [(param, grad_handle)] (reference:
+    python/paddle/base/backward.py append_backward)."""
+    prog = loss._prog_ref[0]
+    if parameter_list is None:
+        parameter_list = [t for t in prog._externals.values()
+                          if not t.stop_gradient]
+    grads = gradients(loss, list(parameter_list))
+    return list(zip(parameter_list, grads))
+
+
+class Executor:
+    """Compile-and-run the recorded program (reference:
+    StandaloneExecutor; here the whole program replays inside one jax.jit
+    per feed-shape signature)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        feed = feed or {}
+        # legacy convenience: Executor.run(callable)
+        if callable(program) and not isinstance(program, Program):
+            out = program(**feed)
+            return out if isinstance(out, (list, tuple)) else [out]
+        if program is None:
+            program = default_main_program()
+        if not program._nodes:  # startup program: params already initialized
+            return []
+        if not fetch_list:
+            return []
+        fetch_list = (fetch_list if isinstance(fetch_list, (list, tuple))
+                      else [fetch_list])
+
+        missing = sorted(set(program._feeds) - set(feed))
+        if missing:
+            raise KeyError(f"missing feed(s) {missing}: every data() "
+                           f"placeholder of the program must be fed")
+        feed_vids = []
+        feed_vals = []
+        for name, val in sorted(feed.items()):
+            if name not in program._feeds:
+                raise KeyError(f"feed '{name}' is not a data() placeholder "
+                               f"of this program (have "
+                               f"{sorted(program._feeds)})")
+            feed_vids.append(program._feeds[name])
+            feed_vals.append(jnp.asarray(val))
+
+        fetch_spec = []
+        for f in fetch_list:
+            if isinstance(f, _GradVar):
+                fetch_spec.append(("grad", f.target_vids, f.wrt_vid))
+            else:
+                ref = getattr(f, "_prog_ref", None)
+                if ref is None or ref[0]._origin is not program._origin:
+                    raise ValueError("fetch target is not a variable of "
+                                     "this program")
+                fetch_spec.append(("val", ref[1], None))
+
+        ext_vids = sorted(program._externals)
+        ext_vals = [program._externals[v]._data for v in ext_vids]
+
+        key = (tuple(feed_vids),
+               tuple((v.shape, str(v.dtype)) for v in feed_vals),
+               tuple(fetch_spec))
+        compiled = program._compile_cache.get(key)
+        if compiled is None:
+            def replay(feeds, exts):
+                env0 = dict(zip(feed_vids, feeds))
+                env0.update(zip(ext_vids, exts))
+                env = program._run_nodes(dict(env0))
+                results = []
+                for kind, a, b in fetch_spec:
+                    if kind == "val":
+                        results.append(env[a])
+                        continue
+
+                    def scalar_target(wval, _ts=a, _b=b):
+                        e = program._run_nodes(dict(env0), override_vid=_b,
+                                               override_val=wval)
+                        return sum(jnp.sum(e[t]) for t in _ts)
+                    # differentiate at the variable's actual value — for
+                    # feeds/externals that's env0, for intermediates the
+                    # forward pass's produced value
+                    at = env0.get(b, env.get(b))
+                    results.append(jax.grad(scalar_target)(at))
+                return results
+
+            compiled = jax.jit(replay)
+            program._compile_cache[key] = compiled
+        outs = compiled(feed_vals, ext_vals)
+        return [np.asarray(o) for o in outs]
+
+    def close(self):
+        pass
+
+
+class CompiledProgram:
+    """Compat alias: programs always compile via jax.jit on first run."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+class nn:
+    """Static-mode layer helpers (reference: python/paddle/static/nn/)."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        import paddle_tpu as paddle
+        # dims [num_flatten_dims:] flatten into the weight's input dim
+        # (reference: static/nn/common.py fc)
+        nfd = num_flatten_dims if num_flatten_dims >= 0 else len(x.shape) - 1
+        in_feats = int(np.prod([int(s) for s in x.shape[nfd:]]))
+        layer = paddle.nn.Linear(in_feats, size)
+        prog = STATE.recording_program
+        if prog is not None:
+            prog._keepalive.append(layer)
+        if nfd != len(x.shape) - 1:
+            # -1 on the batch dim keeps the program feed-shape-polymorphic
+            lead = [-1] + [int(s) for s in x.shape[1:nfd]]
+            x = paddle.reshape(x, lead + [in_feats])
+        out = layer(x)
+        if activation == "relu":
+            out = paddle.nn.functional.relu(out)
+        elif activation == "tanh":
+            out = paddle.tanh(out)
+        elif activation == "softmax":
+            out = paddle.nn.functional.softmax(out)
+        elif activation is not None:
+            raise ValueError(f"unsupported fc activation '{activation}'")
+        return out
+
+    @staticmethod
+    def sparse_embedding(input, size, **kwargs):
+        from ..distributed.ps import SparseEmbedding
+        emb = SparseEmbedding(kwargs.get("name", "sparse_emb"),
+                              size[0], size[1])
+        return emb(input)
+
+
+def save(program, path):
+    """Persist the program's parameters (the program structure itself lives
+    in python; for a deployable artifact use paddle_tpu.jit.save →
+    StableHLO)."""
+    arrs = {str(vid): np.asarray(t._data)
+            for vid, t in program._externals.items()}
+    np.savez(path if path.endswith(".npz") else path + ".npz", **arrs)
+
+
+def load(program, path):
+    data_ = np.load(path if path.endswith(".npz") else path + ".npz")
+    for vid_s, arr in data_.items():
+        t = program._externals.get(int(vid_s))
+        if t is not None:
+            t._data = jnp.asarray(arr)
+
+
+def global_scope():
+    return _DEFAULT_MAIN
+
+
+class scope_guard:
+    def __init__(self, scope):
         pass
 
     def __enter__(self):
@@ -56,38 +426,6 @@ class program_guard:
 
     def __exit__(self, *a):
         return False
-
-
-class Executor:
-    def __init__(self, place=None):
-        self.place = place
-
-    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        if callable(program):
-            out = program(**(feed or {}))
-            return out if isinstance(out, (list, tuple)) else [out]
-        if fetch_list:
-            return [f.numpy() if isinstance(f, Tensor) else f
-                    for f in fetch_list]
-        return []
-
-
-def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape, dtype, name)
-
-
-class nn:
-    @staticmethod
-    def fc(x, size, **kwargs):
-        raise NotImplementedError("use paddle_tpu.nn.Linear")
-
-
-def save(program, path):
-    pass
-
-
-def load(program, path):
-    pass
 
 
 class amp:

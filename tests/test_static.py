@@ -1,0 +1,234 @@
+"""paddle.static Program/Executor tests.
+
+Reference analogue: test/legacy_test/test_program.py, test_executor_*.py —
+program capture under program_guard, feed/fetch execution, backward.
+Here the program is a recorded kernel list replayed inside one jax.jit
+(see paddle_tpu/static/__init__.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def _build_mlp_program():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        lin = paddle.nn.Linear(4, 3)
+        h = paddle.tanh(lin(x))
+        loss = paddle.mean(h * h)
+    return main, startup, x, lin, h, loss
+
+
+class TestProgramCapture:
+    def test_ops_recorded(self):
+        main, _, x, lin, h, loss = _build_mlp_program()
+        assert "linear" in main.ops
+        assert "tanh" in main.ops
+        assert "mean" in main.ops
+
+    def test_recording_scoped_to_guard(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2])
+            y = paddle.exp(x)
+        n = len(main.ops)
+        # outside the guard nothing is appended
+        paddle.exp(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        assert len(main.ops) == n
+
+    def test_ir_dump_shows_feeds_params_spmd(self):
+        main, *_ = _build_mlp_program()
+        s = str(main)
+        assert "feed['x']" in s
+        assert "param shape=(4, 3)" in s
+        assert "[spmd: elementwise]" in s  # tanh
+        assert "[spmd: reduction]" in s    # mean
+
+    def test_clone(self):
+        main, *_ = _build_mlp_program()
+        c = main.clone(for_test=True)
+        assert c.ops == main.ops
+
+
+class TestExecutor:
+    def test_run_matches_eager(self):
+        main, startup, x, lin, h, loss = _build_mlp_program()
+        exe = static.Executor()
+        assert exe.run(startup) == []
+        arr = np.random.RandomState(0).rand(5, 4).astype(np.float32)
+        got_h, got_loss = exe.run(main, feed={"x": arr},
+                                  fetch_list=[h, loss])
+        ref = paddle.tanh(lin(paddle.to_tensor(arr)))
+        np.testing.assert_allclose(got_h, ref.numpy(), atol=1e-6)
+        np.testing.assert_allclose(got_loss,
+                                   float((ref * ref).mean().numpy()),
+                                   rtol=1e-6)
+
+    def test_feed_shape_polymorphic(self):
+        """data([None, 4]) runs at any batch (each shape compiles once)."""
+        main, _, x, lin, h, _ = _build_mlp_program()
+        exe = static.Executor()
+        for b in (1, 3, 8):
+            out = exe.run(main, feed={"x": np.ones((b, 4), np.float32)},
+                          fetch_list=[h])[0]
+            assert out.shape == (b, 3)
+
+    def test_param_updates_are_live(self):
+        """Externals resolve at run time: updating the layer's weights
+        changes the program's output without re-capture."""
+        main, _, x, lin, h, _ = _build_mlp_program()
+        exe = static.Executor()
+        arr = np.ones((2, 4), np.float32)
+        before = exe.run(main, feed={"x": arr}, fetch_list=[h])[0]
+        with paddle.no_grad():
+            w = lin.parameters()[0]
+            w.set_value(w * 0.0)
+        after = exe.run(main, feed={"x": arr}, fetch_list=[h])[0]
+        assert not np.allclose(before, after)
+        np.testing.assert_allclose(after, 0.0, atol=1e-6)
+
+    def test_unknown_feed_rejected(self):
+        main, *_ = _build_mlp_program()
+        with pytest.raises(KeyError):
+            static.Executor().run(main, feed={"bogus": np.ones(1)},
+                                  fetch_list=[None])
+
+    def test_comparison_ops_replay(self):
+        """logic ops (no-tape path) must be recorded, not baked to the
+        placeholder's value."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4])
+            m = paddle.greater_than(x, paddle.to_tensor(
+                np.zeros(4, np.float32)))
+        exe = static.Executor()
+        out = exe.run(main, feed={"x": np.array([-1, 1, -2, 2],
+                                                np.float32)},
+                      fetch_list=[m])[0]
+        np.testing.assert_array_equal(out, [False, True, False, True])
+
+
+class TestBackward:
+    def test_gradients_match_eager(self):
+        main, _, x, lin, h, loss = _build_mlp_program()
+        w, b = lin.parameters()
+        gw, = static.gradients(loss, [w])
+        exe = static.Executor()
+        arr = np.random.RandomState(1).rand(6, 4).astype(np.float32)
+        got = exe.run(main, feed={"x": arr}, fetch_list=[gw])[0]
+
+        # eager reference
+        w.stop_gradient = False
+        ref_loss = paddle.mean(paddle.tanh(lin(paddle.to_tensor(arr))) ** 2)
+        ref_loss.backward()
+        np.testing.assert_allclose(got, w.grad.numpy(), atol=1e-5)
+
+    def test_append_backward_lists_params(self):
+        main, _, x, lin, h, loss = _build_mlp_program()
+        pairs = static.append_backward(loss)
+        assert len(pairs) == 2  # weight + bias
+        exe = static.Executor()
+        arr = np.ones((2, 4), np.float32)
+        grads = exe.run(main, feed={"x": arr},
+                        fetch_list=[g for _, g in pairs])
+        assert grads[0].shape == tuple(lin.parameters()[0].shape)
+        assert grads[1].shape == tuple(lin.parameters()[1].shape)
+
+
+class TestStaticNN:
+    def test_fc(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 8])
+            y = static.nn.fc(x, 4, activation="relu")
+        out = static.Executor().run(
+            main, feed={"x": np.random.rand(2, 8).astype(np.float32)},
+            fetch_list=[y])[0]
+        assert out.shape == (2, 4)
+        assert (out >= 0).all()
+
+
+def test_save_load_params(tmp_path):
+    main, _, x, lin, h, _ = _build_mlp_program()
+    exe = static.Executor()
+    arr = np.ones((2, 4), np.float32)
+    before = exe.run(main, feed={"x": arr}, fetch_list=[h])[0]
+    p = str(tmp_path / "prog")
+    static.save(main, p)
+    with paddle.no_grad():
+        w = lin.parameters()[0]
+        w.set_value(w + 1.0)
+    changed = exe.run(main, feed={"x": arr}, fetch_list=[h])[0]
+    assert not np.allclose(before, changed)
+    static.load(main, p)
+    restored = exe.run(main, feed={"x": arr}, fetch_list=[h])[0]
+    np.testing.assert_allclose(restored, before, atol=1e-6)
+
+
+class TestReviewedEdges:
+    def test_gradient_wrt_intermediate(self):
+        """d(loss)/d(h) for an intermediate h: downstream-only sensitivity
+        (the producer's value is overridden, not recomputed)."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 3])
+            h = paddle.tanh(x)
+            loss = paddle.sum(h * h)
+        gh, = static.gradients(loss, [h])
+        arr = np.random.RandomState(3).rand(2, 3).astype(np.float32)
+        got = static.Executor().run(main, feed={"x": arr},
+                                    fetch_list=[gh])[0]
+        np.testing.assert_allclose(got, 2.0 * np.tanh(arr), atol=1e-6)
+
+    def test_gradients_sum_over_multiple_targets(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4])
+            a = paddle.sum(x * x)
+            b = paddle.sum(3.0 * x)
+        gx, = static.gradients([a, b], [x])
+        arr = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        got = static.Executor().run(main, feed={"x": arr},
+                                    fetch_list=[gx])[0]
+        np.testing.assert_allclose(got, 2 * arr + 3.0, atol=1e-6)
+
+    def test_target_gradients_rejected(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2])
+            y = paddle.sum(x)
+        with pytest.raises(NotImplementedError):
+            static.gradients(y, [x], target_gradients=[y])
+
+    def test_clone_variables_fetchable(self):
+        main, _, x, lin, h, loss = _build_mlp_program()
+        test_prog = main.clone(for_test=True)
+        out = static.Executor().run(
+            test_prog, feed={"x": np.ones((2, 4), np.float32)},
+            fetch_list=[h])[0]
+        assert out.shape == (2, 3)
+
+    def test_missing_feed_named_in_error(self):
+        main = static.Program()
+        with static.program_guard(main):
+            a = static.data("a", [2])
+            b = static.data("b", [2])
+            y = a + b
+        with pytest.raises(KeyError, match="b"):
+            static.Executor().run(main, feed={"a": np.ones(2, np.float32)},
+                                  fetch_list=[y])
+
+    def test_fc_num_flatten_dims(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 3, 4])
+            y = static.nn.fc(x, 5, num_flatten_dims=1)
+        out = static.Executor().run(
+            main, feed={"x": np.ones((2, 3, 4), np.float32)},
+            fetch_list=[y])[0]
+        assert out.shape == (2, 5)
