@@ -66,6 +66,7 @@ from . import static  # noqa: F401
 from . import text  # noqa: F401
 from . import vision  # noqa: F401
 from .autograd import PyLayer, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .core.selected_rows import SelectedRows  # noqa: F401
 from .device import (CPUPlace, CUDAPlace, TPUPlace, XPUPlace, get_device,  # noqa: F401
                      is_compiled_with_cinn, is_compiled_with_cuda,
                      is_compiled_with_distribute, is_compiled_with_rocm,

@@ -72,7 +72,13 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
     If ``inputs`` is given (paddle.grad semantics) returns their grads as raw
     arrays instead of (only) writing ``.grad``.
     """
+    from .state import STATE
     from .tensor import Tensor  # late import
+
+    # visible to hooks: paddle.grad (accumulate_into_grad=False) promises
+    # not to touch .grad, so side-effecting hooks (sparse embedding's
+    # SelectedRows writer) must stand down during it
+    STATE.accumulating_backward = accumulate_into_grad
 
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
@@ -163,6 +169,9 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                 continue
             if t.grad is None:
                 t.grad = Tensor._wrap(g)
+            elif hasattr(t.grad, "to_dense"):
+                # SelectedRows meeting a dense contribution: merge to dense
+                t.grad = Tensor._wrap(t.grad.to_dense() + g)
             else:
                 t.grad = Tensor._wrap(t.grad._data + g)
     else:

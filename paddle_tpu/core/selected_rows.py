@@ -1,0 +1,48 @@
+"""SelectedRows: the sparse-gradient carrier.
+
+Reference analogue: phi::SelectedRows
+(/root/reference/paddle/phi/core/selected_rows.h — rows + value tensor +
+height), produced by embedding lookup backward when ``sparse=True``
+(lookup_table_v2_grad) and consumed by the sparse sgd/adam kernels.
+
+TPU-native role: on-device it is just (int32 rows, [n, dim] values) — the
+optimizer applies it with one XLA scatter-add, which is exactly what the
+reference's CUDA sparse kernels hand-roll.  The win is identical: a
+vocab-sized embedding with a batch touching k rows moves O(k·dim) gradient
+bytes instead of O(V·dim).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SelectedRows:
+    def __init__(self, rows, values, height):
+        self.rows = jnp.asarray(np.asarray(rows), jnp.int32)
+        self.values = values if hasattr(values, "dtype") else jnp.asarray(
+            values)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return [self.height] + list(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense(self):
+        """Scatter-add into the dense twin (duplicate rows accumulate,
+        matching dense embedding backward)."""
+        dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                          self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"n_rows={self.values.shape[0]}, dim={self.shape[1:]})")

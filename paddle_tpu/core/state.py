@@ -21,6 +21,7 @@ class _State(threading.local):
         self.amp_black = set()
         self.tracing_depth = 0         # >0 while inside jax.jit trace
         self.recording_program = None  # paddle.static Program under guard
+        self.accumulating_backward = True  # False during paddle.grad()
 
 
 STATE = _State()

@@ -199,7 +199,10 @@ class Tensor:
 
     def clear_gradient(self, set_to_zero=False):
         if set_to_zero and self.grad is not None:
-            self.grad = Tensor._wrap(jnp.zeros_like(self.grad._data))
+            if hasattr(self.grad, "to_dense"):  # SelectedRows: drop rows
+                self.grad = Tensor._wrap(jnp.zeros_like(self._data))
+            else:
+                self.grad = Tensor._wrap(jnp.zeros_like(self.grad._data))
         else:
             self.grad = None
 

@@ -43,6 +43,7 @@ class Embedding(Layer):
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
         self._padding_idx = padding_idx
+        self._sparse = sparse
         self.weight = param_attr_init((num_embeddings, embedding_dim),
                                       self._dtype, weight_attr, False,
                                       Normal(0.0, 1.0))
@@ -50,7 +51,68 @@ class Embedding(Layer):
             self.weight._data = self.weight._data.at[padding_idx].set(0.0)
 
     def forward(self, x):
+        if self._sparse:
+            out = self._forward_sparse(x)
+            if out is not None:
+                return out
         return F.embedding(x, self.weight, self._padding_idx)
+
+    def _forward_sparse(self, x):
+        """sparse=True: backward produces a SelectedRows gradient holding
+        only the batch's unique rows (reference: lookup_table_v2_grad's
+        is_sparse path).  Eager-only — under jit tracing ids are abstract,
+        and XLA's scatter in the dense path is already the fused
+        equivalent."""
+        import numpy as np
+
+        from ...core.selected_rows import SelectedRows
+        from ...core.state import STATE, grad_enabled
+        from ...core.tensor import Tensor
+
+        if (STATE.tracing_depth > 0 or not grad_enabled()
+                or self.weight.stop_gradient):
+            return None
+        ids = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+        ids = ids.astype(np.int64)
+        uniq, inv = np.unique(ids.reshape(-1), return_inverse=True)
+        pulled = Tensor._wrap(self.weight._data[uniq])
+        pulled.stop_gradient = False
+        weight = self.weight
+        height = self._num_embeddings
+
+        def to_selected_rows(grad):
+            import jax.numpy as jnp
+            if not STATE.accumulating_backward:
+                # paddle.grad() promises not to touch .grad; the weight is
+                # not in grad()'s graph on the sparse path, so grad(loss,
+                # [weight]) raises its usual unused-input error — use
+                # sparse=False (or weight.grad via backward()) for that
+                return grad
+            prev = weight.grad
+            if isinstance(prev, SelectedRows):  # microbatch accumulation
+                weight.grad = SelectedRows(
+                    jnp.concatenate([prev.rows,
+                                     jnp.asarray(uniq, jnp.int32)]),
+                    jnp.concatenate([prev.values, grad._data]), height)
+            elif prev is not None:  # dense + sparse mix: merge to dense
+                weight.grad = Tensor._wrap(
+                    prev._data
+                    + SelectedRows(uniq, grad._data, height).to_dense())
+            else:
+                weight.grad = SelectedRows(uniq, grad._data, height)
+            return grad
+
+        pulled.register_hook(to_selected_rows)
+        import paddle_tpu as paddle
+        out = paddle.gather(pulled,
+                            paddle.to_tensor(inv.astype(np.int32)))
+        out = out.reshape(list(ids.shape) + [self._embedding_dim])
+        if self._padding_idx is not None:
+            # cast on device so bf16/fp16 weights keep their dtype (the
+            # dense path's jnp.where does the same via weak typing)
+            mask = paddle.to_tensor((ids != self._padding_idx)[..., None])
+            out = out * mask.astype(out.dtype)
+        return out
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

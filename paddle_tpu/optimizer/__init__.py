@@ -105,17 +105,38 @@ class Optimizer:
         return pg
 
     def step(self):
+        from ..core.selected_rows import SelectedRows
         with no_grad_guard():
             pg = self._collect_params_grads()
             if self._grad_clip is not None:
-                pg = self._grad_clip(pg)
+                # SelectedRows grads bypass clipping (the reference's
+                # ClipGradByGlobalNorm squares sparse grads via their own
+                # merged path; here sparse params are embeddings, which
+                # hybrid recipes exclude from the clip group anyway)
+                dense = [(p, g) for p, g in pg
+                         if not isinstance(g, SelectedRows)]
+                sparse = [(p, g) for p, g in pg
+                          if isinstance(g, SelectedRows)]
+                pg = list(self._grad_clip(dense)) + sparse
             self._step_count += 1
             for p, g in pg:
+                if isinstance(g, SelectedRows):
+                    self._update_param_sparse(p, g)
+                    continue
                 self._update_param(p, g._data.astype(jnp.float32)
                                    if self._multi_precision else g._data)
 
     def _update_param(self, p, g):
         raise NotImplementedError
+
+    def _update_param_sparse(self, p, sr):
+        """SelectedRows gradient.  Default: densify (one XLA scatter-add)
+        and run the dense rule — numerically identical to a dense grad.
+        Optimizers with a true row-wise rule override this (SGD; Adam's
+        lazy_mode)."""
+        self._update_param(p, sr.to_dense().astype(jnp.float32)
+                           if self._multi_precision
+                           else sr.to_dense().astype(p._data.dtype))
 
     @property
     def _lr(self):
@@ -181,6 +202,16 @@ class SGD(Optimizer):
             g = g + self._weight_decay * m
         self._write_back(p, m - self._lr * self._param_lr(p) * g)
 
+    def _update_param_sparse(self, p, sr):
+        """Row-wise sparse SGD: touch only the gradient's rows (reference:
+        phi/kernels/.../sgd_kernel.cu SelectedRows overload).  Weight decay
+        is skipped for sparse params, matching the reference's sparse sgd
+        (decay would densify the update)."""
+        m = self._master(p)
+        vals = sr.values.astype(m.dtype)
+        lr = self._lr * self._param_lr(p)
+        self._write_back(p, m.at[sr.rows].add(-lr * vals))
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -211,6 +242,7 @@ class Adam(Optimizer):
                          multi_precision)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._amsgrad = amsgrad
+        self._lazy_mode = lazy_mode
 
     def _moments(self, p, g):
         m = self._acc("moment1", p)
@@ -243,6 +275,46 @@ class Adam(Optimizer):
             p, master - self._lr * self._param_lr(p) * mhat
             / (jnp.sqrt(vhat) + self._eps))
 
+    def _update_param_sparse(self, p, sr):
+        """lazy_mode row-wise Adam (reference: adam_kernel SelectedRows
+        overload with lazy_mode=true — moments of untouched rows stay
+        frozen; beta-pows advance globally).  Without lazy_mode the dense
+        semantics apply (densify; untouched rows still decay their
+        moments).  amsgrad also densifies: its moment2_max is a global
+        running max that a row-wise update would desynchronise."""
+        if not self._lazy_mode or self._amsgrad:
+            return super()._update_param_sparse(p, sr)
+        self._lazy_row_update(p, sr, self._lr * self._param_lr(p),
+                              decay=0.0)
+
+    def _lazy_row_update(self, p, sr, lr, decay):
+        import numpy as np
+
+        rows_np = np.asarray(sr.rows)
+        uniq, inv = np.unique(rows_np, return_inverse=True)
+        g = jnp.zeros((uniq.size,) + tuple(sr.values.shape[1:]),
+                      jnp.float32).at[inv].add(
+                          sr.values.astype(jnp.float32))
+        rows = jnp.asarray(uniq, jnp.int32)
+        master = self._master(p)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        b1p = self._acc("beta1_pow", p, jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, jnp.asarray(1.0, jnp.float32))
+        mr = self._beta1 * m[rows] + (1 - self._beta1) * g
+        vr = self._beta2 * v[rows] + (1 - self._beta2) * g * g
+        b1p, b2p = b1p * self._beta1, b2p * self._beta2
+        self._set_acc("moment1", p, m.at[rows].set(mr))
+        self._set_acc("moment2", p, v.at[rows].set(vr))
+        self._set_acc("beta1_pow", p, b1p)
+        self._set_acc("beta2_pow", p, b2p)
+        mhat = mr / (1 - b1p)
+        vhat = vr / (1 - b2p)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        # decoupled decay (AdamW) applies to the touched rows only
+        new_rows = master[rows] * (1 - lr * decay) - upd
+        self._write_back(p, master.at[rows].set(new_rows))
+
 
 class AdamW(Adam):
     """Decoupled weight decay (reference: optimizer/adamw.py → adamw_ kernel)."""
@@ -273,6 +345,21 @@ class AdamW(Adam):
         new = master * (1 - lr * decay) - lr * mhat / (jnp.sqrt(vhat)
                                                        + self._eps)
         self._write_back(p, new)
+
+    def _update_param_sparse(self, p, sr):
+        """AdamW lazy_mode: the row-wise path must still apply decoupled
+        decay to the touched rows (the densify fallback inherits it via
+        _update_param)."""
+        if not self._lazy_mode or self._amsgrad:
+            return Optimizer._update_param_sparse(self, p, sr)
+        lr = self._lr * self._param_lr(p)
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        decay = self._wd
+        if self._apply_decay_fn is not None and not self._apply_decay_fn(
+                p.name):
+            decay = 0.0
+        self._lazy_row_update(p, sr, lr, decay=decay)
 
 
 class Adagrad(Optimizer):

@@ -7,7 +7,24 @@ class _DownloadGated:
         raise RuntimeError("dataset download disabled in this environment")
 
 
-Conll05st = Imdb = Imikolov = Movielens = UCIHousing = WMT14 = WMT16 = ViterbiDecoder = _DownloadGated
+Conll05st = Imdb = Imikolov = Movielens = UCIHousing = WMT14 = WMT16 = _DownloadGated
+
+from . import strings  # noqa: F401,E402
+from .strings import StringTensor, to_string_tensor  # noqa: F401,E402
+
+
+class ViterbiDecoder:
+    """Layer form of viterbi_decode (reference:
+    python/paddle/text/viterbi_decode.py ViterbiDecoder — a layer, not a
+    dataset)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

@@ -1,0 +1,90 @@
+"""StringTensor + the strings op family.
+
+Reference analogue: phi::StringTensor
+(/root/reference/paddle/phi/core/string_tensor.h) and the four
+strings_ops.yaml ops (empty / empty_like / lower / upper,
+/root/reference/paddle/phi/ops/yaml/strings_ops.yaml).
+
+TPU-native position: XLA has no string element type, so string data is a
+HOST-side preprocessing concern by design — StringTensor wraps a numpy
+object array and the ops run vectorised on host, feeding tokenizers whose
+integer output is what reaches the device (the same division of labor the
+reference uses: its strings kernels are CPU-only except a thin GPU copy).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class StringTensor:
+    """Dense tensor of variable-length python strings (host memory)."""
+
+    def __init__(self, data, name=None):
+        if isinstance(data, StringTensor):
+            data = data._data
+        arr = np.asarray(data, dtype=object)
+        # normalise scalars to 0-d object arrays of str
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        other = other._data if isinstance(other, StringTensor) else other
+        return np.asarray(self._data == other)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+
+def to_string_tensor(data, name=None):
+    """Create a StringTensor from (nested) python strings (the analogue of
+    core.eager.StringTensor construction)."""
+    return StringTensor(data, name)
+
+
+def empty(shape, name=None):
+    """strings_ops.yaml `empty`: uninitialised (here: empty-string) string
+    tensor of the given shape."""
+    return StringTensor(np.full(tuple(shape), "", dtype=object))
+
+
+def empty_like(x, name=None):
+    """strings_ops.yaml `empty_like`."""
+    return StringTensor(np.full(tuple(x.shape), "", dtype=object))
+
+
+def _map(fn, x):
+    return StringTensor(np.frompyfunc(fn, 1, 1)(StringTensor(x)._data))
+
+
+def lower(x, use_utf8_encoding=False, name=None):
+    """strings_ops.yaml `lower`.  use_utf8_encoding=False mirrors the
+    reference's ascii fast path; python's str.lower is already
+    unicode-correct, so both settings lower non-ascii too."""
+    return _map(str.lower, x)
+
+
+def upper(x, use_utf8_encoding=False, name=None):
+    """strings_ops.yaml `upper`."""
+    return _map(str.upper, x)

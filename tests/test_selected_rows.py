@@ -1,0 +1,233 @@
+"""SelectedRows sparse gradients + StringTensor ops.
+
+Reference analogue: test/legacy_test/test_selected_rows.py,
+test_sgd_op.py (SelectedRows overloads), test_adam_op.py lazy_mode,
+test_strings_lower_upper_op.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import SelectedRows
+
+
+def test_to_dense_accumulates_duplicate_rows():
+    sr = SelectedRows(rows=[1, 3, 1], values=np.ones((3, 2), np.float32),
+                      height=5)
+    d = sr.numpy()
+    assert d.shape == (5, 2)
+    np.testing.assert_allclose(d[1], 2.0)
+    np.testing.assert_allclose(d[3], 1.0)
+    np.testing.assert_allclose(d[[0, 2, 4]], 0.0)
+
+
+def _twin_embeddings(V=10, D=4, seed=0):
+    es = paddle.nn.Embedding(V, D, sparse=True)
+    ed = paddle.nn.Embedding(V, D, sparse=False)
+    with paddle.no_grad():
+        ed.weight.set_value(es.weight)
+    return es, ed
+
+
+def test_sparse_embedding_grad_is_selected_rows():
+    es, ed = _twin_embeddings()
+    ids = paddle.to_tensor(np.array([[1, 2], [2, 7]], np.int64))
+    loss_s = (es(ids) ** 2).sum()
+    loss_s.backward()
+    assert isinstance(es.weight.grad, SelectedRows)
+
+    loss_d = (ed(ids) ** 2).sum()
+    loss_d.backward()
+    np.testing.assert_allclose(es.weight.grad.numpy(),
+                               ed.weight.grad.numpy(), atol=1e-6)
+    # only the batch's rows carry gradient mass
+    assert sorted(np.asarray(es.weight.grad.rows).tolist()) == [1, 2, 7]
+
+
+def test_sparse_forward_matches_dense():
+    es, ed = _twin_embeddings()
+    ids = paddle.to_tensor(np.array([[0, 5, 5], [9, 1, 0]], np.int64))
+    np.testing.assert_allclose(es(ids).numpy(), ed(ids).numpy(), atol=1e-6)
+
+
+def test_padding_idx_respected_in_sparse_path():
+    es = paddle.nn.Embedding(8, 3, padding_idx=0, sparse=True)
+    ids = paddle.to_tensor(np.array([0, 2], np.int64))
+    out = es(ids)
+    np.testing.assert_allclose(out.numpy()[0], 0.0, atol=1e-7)
+
+
+def test_sgd_sparse_step_matches_dense_twin():
+    es, ed = _twin_embeddings()
+    opt_s = paddle.optimizer.SGD(0.1, parameters=[es.weight])
+    opt_d = paddle.optimizer.SGD(0.1, parameters=[ed.weight])
+    ids = paddle.to_tensor(np.array([3, 4, 3], np.int64))
+    for _ in range(3):
+        (es(ids) ** 2).sum().backward()
+        opt_s.step()
+        opt_s.clear_grad()
+        (ed(ids) ** 2).sum().backward()
+        opt_d.step()
+        opt_d.clear_grad()
+    np.testing.assert_allclose(es.weight.numpy(), ed.weight.numpy(),
+                               atol=1e-5)
+
+
+def test_adam_dense_fallback_matches_dense_twin():
+    """lazy_mode=False: SelectedRows densifies; trajectory identical to a
+    dense gradient (untouched rows' moments still decay)."""
+    es, ed = _twin_embeddings()
+    opt_s = paddle.optimizer.Adam(0.05, parameters=[es.weight])
+    opt_d = paddle.optimizer.Adam(0.05, parameters=[ed.weight])
+    ids = paddle.to_tensor(np.array([1, 6], np.int64))
+    for _ in range(3):
+        (es(ids) ** 2).sum().backward()
+        opt_s.step()
+        opt_s.clear_grad()
+        (ed(ids) ** 2).sum().backward()
+        opt_d.step()
+        opt_d.clear_grad()
+    np.testing.assert_allclose(es.weight.numpy(), ed.weight.numpy(),
+                               atol=1e-5)
+
+
+def test_adam_lazy_mode_freezes_untouched_rows():
+    es, _ = _twin_embeddings()
+    w0 = es.weight.numpy().copy()
+    opt = paddle.optimizer.Adam(0.05, parameters=[es.weight],
+                                lazy_mode=True)
+    ids = paddle.to_tensor(np.array([2, 5], np.int64))
+    for _ in range(2):
+        (es(ids) ** 2).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    w1 = es.weight.numpy()
+    touched = [2, 5]
+    untouched = [i for i in range(10) if i not in touched]
+    # untouched rows identical; touched rows moved
+    np.testing.assert_allclose(w1[untouched], w0[untouched], atol=1e-7)
+    assert np.abs(w1[touched] - w0[touched]).max() > 1e-4
+
+
+def test_grad_accumulation_concats_rows():
+    es, ed = _twin_embeddings()
+    ids1 = paddle.to_tensor(np.array([1, 2], np.int64))
+    ids2 = paddle.to_tensor(np.array([2, 3], np.int64))
+    (es(ids1) ** 2).sum().backward()
+    (es(ids2) ** 2).sum().backward()
+    (ed(ids1) ** 2).sum().backward()
+    (ed(ids2) ** 2).sum().backward()
+    np.testing.assert_allclose(es.weight.grad.numpy(),
+                               ed.weight.grad.numpy(), atol=1e-6)
+
+
+class TestStrings:
+    def test_lower_upper(self):
+        from paddle_tpu.text import strings
+        st = strings.to_string_tensor([["Hello", "WORLD"], ["TPU", "ok"]])
+        assert st.shape == [2, 2]
+        assert strings.lower(st).tolist() == [["hello", "world"],
+                                              ["tpu", "ok"]]
+        assert strings.upper(st).tolist() == [["HELLO", "WORLD"],
+                                              ["TPU", "OK"]]
+
+    def test_empty_and_like(self):
+        from paddle_tpu.text import strings
+        e = strings.empty([2, 3])
+        assert e.shape == [2, 3] and e.tolist()[0][0] == ""
+        el = strings.empty_like(e)
+        assert el.shape == [2, 3]
+
+    def test_unicode(self):
+        from paddle_tpu.text import strings
+        st = strings.to_string_tensor(["Grüße"])
+        assert strings.upper(st, use_utf8_encoding=True).tolist() == \
+            ["GRÜSSE"]
+
+
+def test_viterbi_decoder_layer():
+    from paddle_tpu.text import ViterbiDecoder
+    rng = np.random.RandomState(0)
+    pot = paddle.to_tensor(rng.rand(1, 3, 4).astype(np.float32))
+    trans = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+    dec = ViterbiDecoder(trans, include_bos_eos_tag=False)
+    scores, path = dec(pot)
+    assert tuple(np.asarray(path.numpy()).shape)[-1] == 3
+
+
+class TestReviewedEdges:
+    def test_mixed_sparse_dense_grad_merges(self):
+        """Weight used both through the sparse lookup and directly: grads
+        merge to dense instead of crashing/overwriting."""
+        es, ed = _twin_embeddings()
+        ids = paddle.to_tensor(np.array([1, 2], np.int64))
+        loss = (es(ids) ** 2).sum() + (es.weight ** 2).sum()
+        loss.backward()
+        loss_d = (ed(ids) ** 2).sum() + (ed.weight ** 2).sum()
+        loss_d.backward()
+        assert not isinstance(es.weight.grad, SelectedRows)
+        np.testing.assert_allclose(es.weight.grad.numpy(),
+                                   ed.weight.grad.numpy(), atol=1e-6)
+
+    def test_paddle_grad_does_not_touch_weight_grad(self):
+        es, _ = _twin_embeddings()
+        ids = paddle.to_tensor(np.array([1, 2], np.int64))
+        loss = (es(ids) ** 2).sum()
+        with pytest.raises(RuntimeError, match="unused"):
+            paddle.grad(loss, [es.weight])
+        assert es.weight.grad is None
+
+    def test_adamw_lazy_mode_applies_decay_to_touched_rows(self):
+        es, _ = _twin_embeddings()
+        w0 = es.weight.numpy().copy()
+        opt = paddle.optimizer.AdamW(0.1, parameters=[es.weight],
+                                     weight_decay=0.5, lazy_mode=True)
+        ids = paddle.to_tensor(np.array([3], np.int64))
+        (es(ids) ** 2).sum().backward()
+        opt.step()
+        # no-decay twin
+        es2, _ = _twin_embeddings()
+        with paddle.no_grad():
+            es2.weight.set_value(paddle.to_tensor(w0))
+        opt2 = paddle.optimizer.AdamW(0.1, parameters=[es2.weight],
+                                      weight_decay=0.0, lazy_mode=True)
+        (es2(ids) ** 2).sum().backward()
+        opt2.step()
+        # decay must move row 3 beyond the pure-adam update
+        assert np.abs(es.weight.numpy()[3]
+                      - es2.weight.numpy()[3]).max() > 1e-4
+        # untouched rows are identical (and undecayed) in both
+        np.testing.assert_allclose(es.weight.numpy()[4], w0[4], atol=1e-7)
+
+    def test_adam_amsgrad_lazy_falls_back_to_dense_semantics(self):
+        es, ed = _twin_embeddings()
+        opt_s = paddle.optimizer.Adam(0.05, parameters=[es.weight],
+                                      lazy_mode=True, amsgrad=True)
+        opt_d = paddle.optimizer.Adam(0.05, parameters=[ed.weight],
+                                      amsgrad=True)
+        ids = paddle.to_tensor(np.array([1, 6], np.int64))
+        for _ in range(2):
+            (es(ids) ** 2).sum().backward()
+            opt_s.step()
+            opt_s.clear_grad()
+            (ed(ids) ** 2).sum().backward()
+            opt_d.step()
+            opt_d.clear_grad()
+        np.testing.assert_allclose(es.weight.numpy(), ed.weight.numpy(),
+                                   atol=1e-5)
+
+    def test_clear_gradient_set_to_zero_on_selected_rows(self):
+        es, _ = _twin_embeddings()
+        ids = paddle.to_tensor(np.array([1], np.int64))
+        (es(ids) ** 2).sum().backward()
+        assert isinstance(es.weight.grad, SelectedRows)
+        es.weight.clear_gradient(set_to_zero=True)
+        np.testing.assert_allclose(es.weight.grad.numpy(), 0.0)
+        assert es.weight.grad.numpy().shape == tuple(es.weight.shape)
+
+    def test_sparse_accepts_array_like_input(self):
+        es, ed = _twin_embeddings()
+        out = es(np.array([1, 2], np.int64))
+        np.testing.assert_allclose(
+            out.numpy(), ed(np.array([1, 2], np.int64)).numpy(), atol=1e-6)
