@@ -82,7 +82,10 @@ def _pick_blocks(h, s, d, itemsize):
     arrays (q, do) plus k/v tiles per head group; `itemsize` is the input
     dtype width (fp32 attention is supported and doubles the footprint).
     """
-    block_q = _round_to_divisor(_env_block("PTPU_FA_BQ", 1024), s)
+    # 512/512 measured best on v5e for the GPT legs (r5 sweep,
+    # scripts/PERF_NOTES.md): 760M batch8 0.474 vs 0.465 at 1024/512;
+    # 1024/256 and 512/256 are 3-5% worse — don't shrink block_k
+    block_q = _round_to_divisor(_env_block("PTPU_FA_BQ", 512), s)
     block_k = _round_to_divisor(_env_block("PTPU_FA_BK", 512), s)
     bh = 1
     for cand in (8, 4, 2):
