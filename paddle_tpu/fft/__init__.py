@@ -45,8 +45,42 @@ rfftn = _mkn("rfftn", jnp.fft.rfftn)
 irfftn = _mkn("irfftn", jnp.fft.irfftn)
 
 
-def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    raise NotImplementedError
+# Hermitian N-d transforms.  jnp.fft has no hfft2/hfftn; the identities
+#   hfftn(x, s, axes, norm)  == irfftn(conj(x), s, axes, swap(norm))
+#   ihfftn(x, s, axes, norm) == conj(rfftn(x, s, axes, swap(norm)))
+# hold because hfft is the FORWARD transform of a Hermitian signal built on
+# the inverse-real machinery (cf. numpy's 1-d np.fft.hfft == irfft(conj)·n);
+# swapping backward<->forward moves the 1/N to the right side, ortho is
+# self-inverse.  (reference: python/paddle/fft.py hfft2/ihfft2/hfftn/ihfftn)
+_SWAP_NORM = {"backward": "forward", "forward": "backward", "ortho": "ortho"}
+
+
+def _mk_hfftn(name, default_axes):
+    def op(x, s=None, axes=default_axes, norm="backward", name_=None):
+        inv = _SWAP_NORM[norm]
+        return apply_op(
+            name,
+            lambda v: jnp.fft.irfftn(jnp.conj(v), s=s, axes=axes, norm=inv),
+            _t(x))
+    op.__name__ = name
+    return op
+
+
+def _mk_ihfftn(name, default_axes):
+    def op(x, s=None, axes=default_axes, norm="backward", name_=None):
+        inv = _SWAP_NORM[norm]
+        return apply_op(
+            name,
+            lambda v: jnp.conj(jnp.fft.rfftn(v, s=s, axes=axes, norm=inv)),
+            _t(x))
+    op.__name__ = name
+    return op
+
+
+hfft2 = _mk_hfftn("hfft2", (-2, -1))
+ihfft2 = _mk_ihfftn("ihfft2", (-2, -1))
+hfftn = _mk_hfftn("hfftn", None)
+ihfftn = _mk_ihfftn("ihfftn", None)
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

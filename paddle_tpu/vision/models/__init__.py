@@ -19,3 +19,4 @@ from .densenet import (DenseNet, densenet121, densenet161,  # noqa: F401
                        densenet169, densenet201, densenet264)
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
 from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
+from .detr import DETR, HungarianMatcher, SetCriterion, detr_resnet50  # noqa: F401

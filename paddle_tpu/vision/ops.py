@@ -270,8 +270,137 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
     return apply_op("yolo_box", fn, x, img_size, nout=2)
 
 
-def yolo_loss(*args, **kwargs):
-    raise NotImplementedError("yolo_loss lands with the detection recipes")
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 loss (reference kernel: phi/kernels/cpu/yolo_loss_kernel.cc /
+    impl/yolo_loss_kernel_impl.h).
+
+    TPU split of labor: target assignment (best-anchor match per gt, grid
+    indexing — integer bookkeeping over a handful of boxes) runs host-side
+    under no_grad; the loss itself (sigmoid-CE on x/y/obj/class, L1 on w/h,
+    all masked + box-size weighted) is one traceable jnp program.
+    x: [N, mask_num*(5+C), H, W]; gt_box: [N, B, 4] (cx,cy,w,h, normalised);
+    gt_label: [N, B] int; anchors: flat [a0w,a0h,a1w,...] in pixels.
+    """
+    xd = np.asarray(x._data) if isinstance(x, Tensor) else np.asarray(x)
+    gb = np.asarray(gt_box._data if isinstance(gt_box, Tensor) else gt_box,
+                    np.float32)
+    gl = np.asarray(gt_label._data if isinstance(gt_label, Tensor)
+                    else gt_label).astype(np.int64)
+    gs = (np.asarray(gt_score._data if isinstance(gt_score, Tensor)
+                     else gt_score, np.float32)
+          if gt_score is not None else np.ones(gl.shape, np.float32))
+    N, _, H, W = xd.shape
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    A = len(mask)
+    C = int(class_num)
+    in_w, in_h = W * downsample_ratio, H * downsample_ratio
+    # reference caps the smoothing delta at 1/40 (yolo_loss_kernel.cc:215)
+    smooth = (min(1.0 / max(C, 1), 1.0 / 40.0)
+              if use_label_smooth and C > 1 else 0.0)
+
+    # ---- host-side target assignment (no_grad) ----------------------------
+    tobj = np.zeros((N, A, H, W), np.float32)       # objectness target
+    tscale = np.zeros((N, A, H, W), np.float32)     # 2 - w*h box weight
+    txy = np.zeros((N, A, 2, H, W), np.float32)
+    twh = np.zeros((N, A, 2, H, W), np.float32)
+    tcls = np.full((N, A, C, H, W), smooth * 0.0, np.float32)
+    gt_xyxy = []                                    # for the ignore mask
+    for n in range(N):
+        boxes_n = []
+        for b in range(gb.shape[1]):
+            cx, cy, w, h = gb[n, b]
+            if w <= 0 or h <= 0:
+                continue
+            boxes_n.append((cx, cy, w, h))
+            # best anchor by wh-IoU over ALL anchors (yolo_loss_kernel_impl.h)
+            bw, bh = w * in_w, h * in_h
+            inter = np.minimum(an[:, 0], bw) * np.minimum(an[:, 1], bh)
+            union = an[:, 0] * an[:, 1] + bw * bh - inter
+            best = int(np.argmax(inter / np.maximum(union, 1e-9)))
+            if best not in mask:
+                continue
+            a = mask.index(best)
+            gi = min(int(cx * W), W - 1)
+            gj = min(int(cy * H), H - 1)
+            tobj[n, a, gj, gi] = gs[n, b]
+            tscale[n, a, gj, gi] = 2.0 - w * h
+            txy[n, a, 0, gj, gi] = cx * W - gi
+            txy[n, a, 1, gj, gi] = cy * H - gj
+            twh[n, a, 0, gj, gi] = np.log(max(bw / an[best, 0], 1e-9))
+            twh[n, a, 1, gj, gi] = np.log(max(bh / an[best, 1], 1e-9))
+            lbl = int(gl[n, b])
+            tcls[n, a, :, gj, gi] = smooth
+            tcls[n, a, lbl, gj, gi] = 1.0 - smooth
+        gt_xyxy.append(boxes_n)
+
+    # pad per-image gt lists to one array for the traceable ignore mask
+    maxg = max(1, max(len(b) for b in gt_xyxy))
+    gt_pad = np.zeros((N, maxg, 4), np.float32)
+    gt_valid = np.zeros((N, maxg), np.float32)
+    for n, bx in enumerate(gt_xyxy):
+        for i, (cx, cy, w, h) in enumerate(bx):
+            gt_pad[n, i] = (cx, cy, w, h)
+            gt_valid[n, i] = 1.0
+
+    anc = an[mask]                                   # [A, 2]
+    consts = map(jnp.asarray, (tobj, tscale, txy, twh, tcls, gt_pad,
+                               gt_valid, anc))
+    tobj_j, tscale_j, txy_j, twh_j, tcls_j, gt_j, gv_j, anc_j = consts
+
+    def _bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + jnp.log1p(
+            jnp.exp(-jnp.abs(logit)))
+
+    def fn(v):
+        p = v.reshape(N, A, 5 + C, H, W)
+        pxy, pwh = p[:, :, 0:2], p[:, :, 2:4]
+        pobj, pcls = p[:, :, 4], p[:, :, 5:]
+        # predicted boxes (normalised) for the ignore mask; x/y decode is
+        # sigmoid(x)*scale + bias with bias = -0.5*(scale-1)
+        # (yolo_loss_kernel.cc:64-65; mirrors yolo_box above)
+        bias_xy = -0.5 * (scale_x_y - 1.0)
+        gx = (jnp.arange(W).reshape(1, 1, 1, W) +
+              jax.nn.sigmoid(pxy[:, :, 0]) * scale_x_y + bias_xy) / W
+        gy = (jnp.arange(H).reshape(1, 1, H, 1) +
+              jax.nn.sigmoid(pxy[:, :, 1]) * scale_x_y + bias_xy) / H
+        pw = jnp.exp(pwh[:, :, 0]) * anc_j[None, :, 0, None, None] / in_w
+        ph = jnp.exp(pwh[:, :, 1]) * anc_j[None, :, 1, None, None] / in_h
+        # IoU of every predicted box vs every gt (cxcywh)
+        px1, py1 = gx - pw / 2, gy - ph / 2
+        px2, py2 = gx + pw / 2, gy + ph / 2
+        g = gt_j[:, None, None, None, :, :]          # [N,1,1,1,G,4]
+        gx1 = g[..., 0] - g[..., 2] / 2
+        gy1 = g[..., 1] - g[..., 3] / 2
+        gx2 = g[..., 0] + g[..., 2] / 2
+        gy2 = g[..., 1] + g[..., 3] / 2
+        ix1 = jnp.maximum(px1[..., None], gx1)
+        iy1 = jnp.maximum(py1[..., None], gy1)
+        ix2 = jnp.minimum(px2[..., None], gx2)
+        iy2 = jnp.minimum(py2[..., None], gy2)
+        inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+        union = (pw * ph)[..., None] + g[..., 2] * g[..., 3] - inter
+        iou = inter / jnp.maximum(union, 1e-9)
+        best_iou = jnp.max(iou * gv_j[:, None, None, None, :], axis=-1)
+        noobj = (best_iou < ignore_thresh).astype(v.dtype)
+
+        w_box = tscale_j * tobj_j
+        loss_xy = (_bce(pxy, txy_j) * w_box[:, :, None]).sum(axis=(1, 2, 3,
+                                                                  4))
+        loss_wh = (jnp.abs(pwh - twh_j) * w_box[:, :, None]).sum(
+            axis=(1, 2, 3, 4))
+        obj_pos = (_bce(pobj, jnp.ones_like(pobj)) * tobj_j)
+        obj_neg = (_bce(pobj, jnp.zeros_like(pobj))
+                   * (1.0 - (tobj_j > 0)) * noobj)
+        loss_obj = (obj_pos + obj_neg).sum(axis=(1, 2, 3))
+        loss_cls = (_bce(pcls, tcls_j)
+                    * tobj_j[:, :, None]).sum(axis=(1, 2, 3, 4))
+        return loss_xy + loss_wh + loss_obj + loss_cls   # [N]
+
+    return apply_op("yolo_loss", fn, x if isinstance(x, Tensor)
+                    else Tensor(x))
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
@@ -290,8 +419,93 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     return outs, Tensor._wrap(jnp.asarray(restore.astype(np.int32)))
 
 
-def generate_proposals(*args, **kwargs):
-    raise NotImplementedError
+def _adaptive_nms(boxes, scores, thresh, eta=1.0):
+    """Greedy NMS with the reference's adaptive threshold: after each kept
+    box, thresh *= eta while thresh > 0.5 (generate_proposals_kernel.cc:185).
+    Returns kept indices in descending-score order."""
+    order = np.argsort(-scores)
+    area = ((boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]))
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    t = thresh
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(boxes[i, 0], boxes[order, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order, 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        iou = inter / (area[i] + area[order] - inter + 1e-10)
+        suppressed[order[iou > t]] = True
+        suppressed[i] = False
+        if eta < 1.0 and t > 0.5:
+            t *= eta
+    return np.asarray(keep, np.int64)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference kernel:
+    phi/kernels/gpu/generate_proposals_kernel.cu).  Host-side numpy by
+    design: the output is ragged and NMS is sequential — this is an
+    inference-time op feeding roi_align, whose compute IS on device.
+    scores [N,A,H,W], bbox_deltas [N,4A,H,W], anchors/variances [H,W,A,4]
+    (or flat [-1,4]), img_size [N,2] (h,w)."""
+    sc = np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    bd = np.asarray(bbox_deltas._data if isinstance(bbox_deltas, Tensor)
+                    else bbox_deltas)
+    ims = np.asarray(img_size._data if isinstance(img_size, Tensor)
+                     else img_size)
+    anc = np.asarray(anchors._data if isinstance(anchors, Tensor)
+                     else anchors).reshape(-1, 4)
+    var = np.asarray(variances._data if isinstance(variances, Tensor)
+                     else variances).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    # reference clamps: boxes under 1px never survive
+    # (generate_proposals_kernel.cc:76)
+    min_size = max(min_size, 1.0)
+
+    all_rois, all_probs, rois_num = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)            # [H*W*A]
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], anc[order], var[order]
+        # decode (box_coder decode_center_size with variances)
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        ax = a[:, 0] + aw * 0.5
+        ay = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + ax
+        cy = v[:, 1] * d[:, 1] * ah + ay
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], axis=1)
+        ih, iw = ims[n, 0], ims[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                   & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep_sz], s[keep_sz]
+        keep = _adaptive_nms(boxes, s, nms_thresh, eta)[:post_nms_top_n]
+        all_rois.append(boxes[keep])
+        all_probs.append(s[keep])
+        rois_num.append(len(keep))
+    rois = Tensor._wrap(jnp.asarray(np.concatenate(all_rois, 0)
+                                    .astype(np.float32)))
+    probs = Tensor._wrap(jnp.asarray(np.concatenate(all_probs, 0)
+                                     .astype(np.float32)))
+    if return_rois_num:
+        return rois, probs, Tensor._wrap(jnp.asarray(rois_num,
+                                                     jnp.int32))
+    return rois, probs
 
 
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
@@ -315,5 +529,30 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
     return apply_op("box_coder", fn, prior_box, prior_box_var, target_box)
 
 
-def psroi_pool(*args, **kwargs):
-    raise NotImplementedError
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive ROI pooling (R-FCN; reference kernel:
+    phi/kernels/gpu/psroi_pool_kernel.cu).  Bin (i,j) of output channel c
+    pools from input channel c*ph*pw + i*pw + j.  Built on roi_align's
+    sampled averaging (sr=2 bilinear samples per bin approximates the
+    reference's exact in-bin average; same device-side gather/matmul
+    machinery)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    C = int(x.shape[1])
+    if C % (ph * pw):
+        raise ValueError(f"psroi_pool: input channels {C} must be a "
+                         f"multiple of output_size {ph}x{pw}")
+    out_c = C // (ph * pw)
+    pooled = roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                       sampling_ratio=2, aligned=False)   # [R, C, ph, pw]
+
+    def fn(p):
+        # channel c*ph*pw + i*pw + j at bin (i, j)
+        p5 = p.reshape(p.shape[0], out_c, ph, pw, ph, pw)
+        ii = jnp.arange(ph)[:, None]
+        jj = jnp.arange(pw)[None, :]
+        return p5[:, :, ii, jj, ii, jj]                   # [R, out_c, ph, pw]
+
+    return apply_op("psroi_pool", fn, pooled)

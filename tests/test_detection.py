@@ -1,0 +1,242 @@
+"""Detection: DETR end-to-end + yolo_loss / generate_proposals / psroi_pool.
+
+Reference analogue: BASELINE.md config #4 ("PP-YOLOE / DETR object detection
+trains end-to-end") and the per-op tests test_yolov3_loss_op.py,
+test_generate_proposals_v2_op.py, test_psroi_pool_op.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+from paddle_tpu.vision.models import (DETR, HungarianMatcher, SetCriterion,
+                                      detr_resnet50)
+from paddle_tpu.vision.models.detr import (box_cxcywh_to_xyxy,
+                                           generalized_box_iou)
+
+
+def _tiny_detr():
+    return DETR(num_classes=5, num_queries=8, hidden_dim=32, nheads=4,
+                num_encoder_layers=1, num_decoder_layers=1,
+                backbone="resnet18", dim_feedforward=64, dropout=0.0)
+
+
+def _targets():
+    return [
+        {"labels": np.array([1, 3]),
+         "boxes": np.array([[0.3, 0.3, 0.2, 0.2],
+                            [0.7, 0.6, 0.2, 0.3]], np.float32)},
+        {"labels": np.array([2]),
+         "boxes": np.array([[0.5, 0.5, 0.4, 0.4]], np.float32)},
+    ]
+
+
+class TestDETR:
+    def test_forward_shapes(self):
+        model = _tiny_detr()
+        imgs = paddle.to_tensor(np.random.RandomState(0)
+                                .rand(2, 3, 64, 64).astype(np.float32))
+        out = model(imgs)
+        assert list(out["pred_logits"].shape) == [2, 8, 6]  # C+1
+        assert list(out["pred_boxes"].shape) == [2, 8, 4]
+        b = out["pred_boxes"].numpy()
+        assert (b >= 0).all() and (b <= 1).all()  # sigmoid cxcywh
+
+    def test_trains_end_to_end(self):
+        model = _tiny_detr()
+        crit = SetCriterion(num_classes=5)
+        imgs = paddle.to_tensor(np.random.RandomState(0)
+                                .rand(2, 3, 64, 64).astype(np.float32))
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+        losses = []
+        for _ in range(6):
+            l = crit(model(imgs), _targets())
+            l["loss"].backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(l["loss"].numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_empty_targets(self):
+        model = _tiny_detr()
+        crit = SetCriterion(num_classes=5)
+        imgs = paddle.to_tensor(np.random.RandomState(1)
+                                .rand(1, 3, 64, 64).astype(np.float32))
+        tgt = [{"labels": np.zeros(0, np.int64),
+                "boxes": np.zeros((0, 4), np.float32)}]
+        l = crit(model(imgs), tgt)
+        assert np.isfinite(float(l["loss"].numpy()))
+        assert float(l["loss_bbox"].numpy()) == 0.0
+
+    def test_matcher_prefers_matching_class_and_box(self):
+        """Hand-built outputs: query 1 predicts the gt box+class, query 0
+        predicts garbage — the matcher must pick query 1."""
+        logits = np.full((1, 2, 3), -5.0, np.float32)
+        logits[0, 1, 0] = 5.0            # query 1 -> class 0
+        boxes = np.array([[[0.9, 0.9, 0.05, 0.05],
+                           [0.3, 0.3, 0.2, 0.2]]], np.float32)
+        out = {"pred_logits": paddle.to_tensor(logits),
+               "pred_boxes": paddle.to_tensor(boxes)}
+        tgt = [{"labels": np.array([0]),
+                "boxes": np.array([[0.3, 0.3, 0.2, 0.2]], np.float32)}]
+        (qi, ti), = HungarianMatcher()(out, tgt)
+        assert qi.tolist() == [1] and ti.tolist() == [0]
+
+    def test_giou_identity_and_disjoint(self):
+        import jax.numpy as jnp
+        a = jnp.asarray([[0.0, 0.0, 1.0, 1.0]])
+        b = jnp.asarray([[2.0, 2.0, 3.0, 3.0]])
+        assert float(generalized_box_iou(a, a)[0, 0]) == pytest.approx(1.0)
+        assert float(generalized_box_iou(a, b)[0, 0]) < 0  # disjoint < 0
+
+    def test_detr_resnet50_constructs(self):
+        m = detr_resnet50(num_classes=3, num_queries=4, hidden_dim=32,
+                          nheads=4, num_encoder_layers=1,
+                          num_decoder_layers=1, dim_feedforward=32)
+        assert m.num_queries == 4
+
+
+class TestYoloLoss:
+    def _inputs(self, seed=0):
+        rng = np.random.RandomState(seed)
+        N, A, C, H, W = 2, 3, 4, 8, 8
+        x = paddle.to_tensor(rng.randn(N, A * (5 + C), H, W)
+                             .astype(np.float32) * 0.1)
+        gt_box = paddle.to_tensor(np.array(
+            [[[0.4, 0.4, 0.3, 0.3], [0, 0, 0, 0]],
+             [[0.6, 0.5, 0.5, 0.4], [0.2, 0.2, 0.1, 0.1]]], np.float32))
+        gt_label = paddle.to_tensor(np.array([[1, 0], [2, 3]], np.int64))
+        anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119]
+        return x, gt_box, gt_label, anchors
+
+    def test_shape_and_positive(self):
+        x, gb, gl, anchors = self._inputs()
+        loss = vops.yolo_loss(x, gb, gl, anchors, anchor_mask=[0, 1, 2],
+                              class_num=4, ignore_thresh=0.7,
+                              downsample_ratio=32)
+        assert list(loss.shape) == [2]
+        assert (loss.numpy() > 0).all()
+
+    def test_gradient_flows_and_training_decreases(self):
+        x, gb, gl, anchors = self._inputs()
+        x.stop_gradient = False
+        vals = []
+        for _ in range(8):
+            loss = vops.yolo_loss(x, gb, gl, anchors, anchor_mask=[0, 1, 2],
+                                  class_num=4, ignore_thresh=0.7,
+                                  downsample_ratio=32).sum()
+            loss.backward()
+            with paddle.no_grad():
+                x = paddle.to_tensor((x - 0.5 * x.grad).numpy())
+            x.stop_gradient = False
+            vals.append(float(loss.numpy()))
+        assert vals[-1] < vals[0]
+
+    def test_gt_score_weighting(self):
+        """A down-weighted gt (score 0.2, mixup-style) must shrink the loss
+        of the image whose gt actually matches a masked anchor (image 1;
+        image 0's best anchor is #5, outside mask [0,1,2], so it carries no
+        targets and is invariant by construction)."""
+        x, gb, gl, anchors = self._inputs()
+        full = vops.yolo_loss(x, gb, gl, anchors, anchor_mask=[0, 1, 2],
+                              class_num=4, ignore_thresh=0.7,
+                              downsample_ratio=32,
+                              gt_score=paddle.to_tensor(
+                                  np.ones((2, 2), np.float32)))
+        soft = vops.yolo_loss(x, gb, gl, anchors, anchor_mask=[0, 1, 2],
+                              class_num=4, ignore_thresh=0.7,
+                              downsample_ratio=32,
+                              gt_score=paddle.to_tensor(
+                                  np.full((2, 2), 0.2, np.float32)))
+        assert soft.numpy()[1] < full.numpy()[1]
+        np.testing.assert_allclose(soft.numpy()[0], full.numpy()[0],
+                                   rtol=1e-6)
+
+    def test_scale_x_y_changes_ignore_mask_decode(self):
+        """scale_x_y reshapes the decoded centers feeding the ignore mask;
+        large logits + low thresh make threshold crossings certain."""
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 3 * 9, 8, 8)
+                             .astype(np.float32) * 2.0)
+        _, gb, gl, anchors = self._inputs()
+        a = vops.yolo_loss(x, gb, gl, anchors, anchor_mask=[3, 4, 5],
+                           class_num=4, ignore_thresh=0.1,
+                           downsample_ratio=32, scale_x_y=1.0)
+        b = vops.yolo_loss(x, gb, gl, anchors, anchor_mask=[3, 4, 5],
+                           class_num=4, ignore_thresh=0.1,
+                           downsample_ratio=32, scale_x_y=2.0)
+        assert not np.allclose(a.numpy(), b.numpy())
+
+
+class TestGenerateProposals:
+    def test_decode_clip_nms(self):
+        N, A, H, W = 1, 2, 2, 2
+        scores = paddle.to_tensor(np.array(
+            [[[[0.9, 0.1], [0.2, 0.3]],
+              [[0.8, 0.05], [0.1, 0.6]]]], np.float32))
+        deltas = paddle.to_tensor(np.zeros((N, 4 * A, H, W), np.float32))
+        anchors = np.zeros((H, W, A, 4), np.float32)
+        for i in range(H):
+            for j in range(W):
+                anchors[i, j, 0] = (j * 8, i * 8, j * 8 + 16, i * 8 + 16)
+                anchors[i, j, 1] = (j * 8, i * 8, j * 8 + 32, i * 8 + 32)
+        variances = np.ones_like(anchors)
+        rois, probs, num = vops.generate_proposals(
+            scores, deltas, paddle.to_tensor(np.array([[24.0, 24.0]],
+                                                      np.float32)),
+            paddle.to_tensor(anchors), paddle.to_tensor(variances),
+            pre_nms_top_n=8, post_nms_top_n=4, nms_thresh=0.9,
+            min_size=1.0, return_rois_num=True)
+        r = rois.numpy()
+        assert r.shape[1] == 4
+        assert int(num.numpy()[0]) == r.shape[0] <= 4
+        # zero deltas with unit variances decode back to the anchors
+        # (clipped); highest-score anchor must be first
+        assert (r[:, 0] >= 0).all() and (r[:, 2] <= 24).all()
+        # scores sorted descending
+        p = probs.numpy()
+        assert (np.diff(p) <= 1e-6).all()
+
+    def test_min_size_filters(self):
+        scores = paddle.to_tensor(np.ones((1, 1, 1, 1), np.float32))
+        deltas = paddle.to_tensor(np.zeros((1, 4, 1, 1), np.float32))
+        anchors = paddle.to_tensor(np.array([[[[0, 0, 2, 2]]]], np.float32))
+        variances = paddle.to_tensor(np.ones((1, 1, 1, 4), np.float32))
+        rois, probs = vops.generate_proposals(
+            scores, deltas, paddle.to_tensor(np.array([[100.0, 100.0]],
+                                                      np.float32)),
+            anchors, variances, min_size=50.0)
+        assert rois.numpy().shape[0] == 0
+
+
+class TestPsroiPool:
+    def test_position_sensitive_channel_pick(self):
+        ph = pw = 2
+        out_c = 3
+        C = out_c * ph * pw
+        # each input channel filled with its own index
+        x = np.zeros((1, C, 8, 8), np.float32)
+        for c in range(C):
+            x[0, c] = c
+        boxes = paddle.to_tensor(np.array([[0.0, 0.0, 8.0, 8.0]],
+                                          np.float32))
+        out = vops.psroi_pool(paddle.to_tensor(x), boxes,
+                              paddle.to_tensor(np.array([1], np.int32)),
+                              output_size=2)
+        got = out.numpy()
+        assert got.shape == (1, out_c, ph, pw)
+        # bin (i,j) of output channel oc must read channel oc*4 + i*2 + j
+        for oc in range(out_c):
+            for i in range(ph):
+                for j in range(pw):
+                    np.testing.assert_allclose(got[0, oc, i, j],
+                                               oc * 4 + i * 2 + j,
+                                               atol=1e-4)
+
+    def test_channel_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            vops.psroi_pool(
+                paddle.to_tensor(np.zeros((1, 7, 8, 8), np.float32)),
+                paddle.to_tensor(np.zeros((1, 4), np.float32)),
+                paddle.to_tensor(np.array([1], np.int32)), 2)

@@ -90,3 +90,33 @@ class TestStft:
                                    length=64)
         assert np.allclose(np.asarray(back.numpy()), x, atol=1e-4), \
             np.abs(np.asarray(back.numpy()) - x).max()
+
+
+class TestHermitianFFT:
+    """hfft2/ihfft2/hfftn/ihfftn via the irfftn(conj)/conj(rfftn) identities
+    (reference: python/paddle/fft.py); torch.fft is the oracle."""
+
+    @pytest.mark.parametrize("norm", ["backward", "forward", "ortho"])
+    def test_matches_torch(self, norm):
+        import torch
+
+        from paddle_tpu import fft as pfft
+        rng = np.random.RandomState(0)
+        x = (rng.rand(4, 6) + 1j * rng.rand(4, 6)).astype(np.complex64)
+        xr = rng.rand(4, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            pfft.hfft2(paddle.to_tensor(x), norm=norm).numpy(),
+            torch.fft.hfft2(torch.from_numpy(x), norm=norm).numpy(),
+            atol=1e-4)
+        np.testing.assert_allclose(
+            pfft.ihfft2(paddle.to_tensor(xr), norm=norm).numpy(),
+            torch.fft.ihfft2(torch.from_numpy(xr), norm=norm).numpy(),
+            atol=1e-5)
+        np.testing.assert_allclose(
+            pfft.hfftn(paddle.to_tensor(x), norm=norm).numpy(),
+            torch.fft.hfftn(torch.from_numpy(x), norm=norm).numpy(),
+            atol=1e-4)
+        np.testing.assert_allclose(
+            pfft.ihfftn(paddle.to_tensor(xr), norm=norm).numpy(),
+            torch.fft.ihfftn(torch.from_numpy(xr), norm=norm).numpy(),
+            atol=1e-5)
