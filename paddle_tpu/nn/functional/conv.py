@@ -91,8 +91,27 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
     dilations = _ntuple(dilation, spatial)
     opad = _ntuple(output_padding, spatial)
     if isinstance(padding, str):
-        raise NotImplementedError("string padding for conv_transpose")
-    pads = _padding(padding, spatial)
+        p = padding.upper()
+        if p == "VALID":
+            pads = [(0, 0)] * spatial
+        elif p == "SAME":
+            # SAME for transpose: output = input * stride, i.e. total pad
+            # k_eff - s per dim (reference: conv2d_transpose 'SAME' docs)
+            pads = []
+            for i in range(spatial):
+                k_eff = (int(weight.shape[2 + i]) - 1) * dilations[i] + 1
+                if k_eff < strides[i]:
+                    raise ValueError(
+                        f"{op_name}: padding='SAME' needs kernel_extent "
+                        f">= stride (got {k_eff} < {strides[i]} on dim "
+                        f"{i}); pass explicit padding/output_padding")
+                total = k_eff - strides[i]
+                pads.append((total // 2, total - total // 2))
+        else:
+            raise ValueError(f"{op_name}: padding={padding!r} "
+                             "(expected 'SAME'/'VALID' or numbers)")
+    else:
+        pads = _padding(padding, spatial)
     ln = ("NC" + "DHW"[3 - spatial:]) if data_format.startswith("NC") \
         else ("N" + "DHW"[3 - spatial:] + "C")
     dn = (ln, "IO" + "DHW"[3 - spatial:], ln)

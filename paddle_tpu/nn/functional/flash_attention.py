@@ -112,5 +112,45 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
 def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
                      key_padding_mask=None, attn_mask=None, name=None):
-    raise NotImplementedError(
-        "sparse_attention: use scaled_dot_product_attention with a mask on TPU")
+    """CSR-masked attention (reference kernel:
+    phi/kernels/gpu/sparse_attention... via paddle.nn.functional
+    .sparse_attention): each query row attends only to the key columns
+    listed in its CSR row.
+
+    TPU-native realisation: the CSR pattern becomes a dense boolean mask
+    (one scatter) and the masked softmax-attention runs as ordinary MXU
+    matmuls — XLA has no gather-attention primitive that beats the dense
+    path until sparsity is extreme, and the mask build is O(nnz).
+    query/key/value: [B, H, S, D]; csr offset [B, H, S+1], columns
+    [B, H, nnz].  Returns [B, H, S, D].
+    """
+    import numpy as np
+
+    from ...core.tensor import Tensor
+
+    off = np.asarray((sparse_csr_offset._data
+                      if isinstance(sparse_csr_offset, Tensor)
+                      else sparse_csr_offset)).astype(np.int64)
+    col = np.asarray((sparse_csr_columns._data
+                      if isinstance(sparse_csr_columns, Tensor)
+                      else sparse_csr_columns)).astype(np.int64)
+    B, H, S = off.shape[0], off.shape[1], off.shape[2] - 1
+    mask = np.zeros((B, H, S, S), bool)
+    for b in range(B):
+        for h in range(H):
+            for i in range(S):
+                cols = col[b, h, off[b, h, i]:off[b, h, i + 1]]
+                mask[b, h, i, cols] = True
+    mask_j = jnp.asarray(mask)
+
+    def fn(qd, kd, vd):
+        d = qd.shape[-1]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qd, kd) / jnp.sqrt(
+            jnp.asarray(d, qd.dtype))
+        logits = jnp.where(mask_j, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        # fully-masked rows (empty CSR row) output zeros, not nan
+        p = jnp.where(mask_j.any(-1, keepdims=True), p, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(vd.dtype), vd)
+
+    return apply_op("sparse_attention", fn, _t(query), _t(key), _t(value))

@@ -301,3 +301,58 @@ class TestLayerBase:
         total = sum(float((p.grad * p.grad).sum().numpy())
                     for p in lin.parameters())
         assert total <= 1.01
+
+
+class TestSparseAttention:
+    """CSR-masked attention (reference: test_sparse_attention_op.py);
+    a causal CSR pattern must reproduce dense causal attention."""
+
+    def test_causal_csr_matches_dense(self):
+        import torch
+
+        rng = np.random.RandomState(0)
+        B, H, S, D = 1, 2, 6, 4
+        q, k, v = (rng.rand(B, H, S, D).astype(np.float32)
+                   for _ in range(3))
+        off = np.zeros((B, H, S + 1), np.int64)
+        for i in range(S):
+            off[:, :, i + 1] = off[:, :, i] + (i + 1)
+        cols = np.asarray([c for i in range(S) for c in range(i + 1)],
+                          np.int64)
+        col = np.broadcast_to(cols, (B, H, cols.size)).copy()
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(off), paddle.to_tensor(col)).numpy()
+        ref = torch.nn.functional.scaled_dot_product_attention(
+            *(torch.from_numpy(a) for a in (q, k, v)),
+            is_causal=True).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_empty_row_outputs_zero(self):
+        q = paddle.to_tensor(np.ones((1, 1, 2, 4), np.float32))
+        off = paddle.to_tensor(np.array([[[0, 0, 1]]], np.int64))  # row 0 empty
+        col = paddle.to_tensor(np.array([[[1]]], np.int64))
+        out = F.sparse_attention(q, q, q, off, col).numpy()
+        np.testing.assert_allclose(out[0, 0, 0], 0.0)
+        np.testing.assert_allclose(out[0, 0, 1], 1.0, atol=1e-6)
+
+
+class TestConvTransposeStringPadding:
+    def test_same_doubles_with_stride2(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(1, 3, 8, 8).astype(np.float32))
+        w = paddle.to_tensor(rng.rand(3, 4, 4, 4).astype(np.float32))
+        y = F.conv2d_transpose(x, w, stride=2, padding="SAME")
+        assert list(y.shape) == [1, 4, 16, 16]
+
+    def test_valid_is_unpadded(self):
+        x = paddle.to_tensor(np.zeros((1, 3, 8, 8), np.float32))
+        w = paddle.to_tensor(np.zeros((3, 4, 4, 4), np.float32))
+        y = F.conv2d_transpose(x, w, stride=2, padding="VALID")
+        assert list(y.shape) == [1, 4, 18, 18]
+
+    def test_same_rejected_when_kernel_smaller_than_stride(self):
+        x = paddle.to_tensor(np.zeros((1, 3, 8, 8), np.float32))
+        w = paddle.to_tensor(np.zeros((3, 4, 2, 2), np.float32))
+        with pytest.raises(ValueError, match="SAME"):
+            F.conv2d_transpose(x, w, stride=4, padding="SAME")
