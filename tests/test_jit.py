@@ -222,3 +222,15 @@ class TestEvalMode:
             assert not net.training and not net[1].training
         assert net.training and net[0].training
         assert not net[1].training  # frozen stays frozen
+
+
+def test_onnx_export_gated_with_alternative():
+    """paddle.onnx.export mirrors the reference's delegation contract
+    (python/paddle/onnx/export.py): without the onnx package it raises and
+    names the StableHLO deployment path."""
+    import paddle_tpu
+
+    lin = paddle.nn.Linear(2, 2)
+    with pytest.raises((RuntimeError, NotImplementedError),
+                       match="jit.save"):
+        paddle_tpu.onnx.export(lin, "/tmp/m", input_spec=None)

@@ -356,3 +356,28 @@ class TestConvTransposeStringPadding:
         w = paddle.to_tensor(np.zeros((3, 4, 2, 2), np.float32))
         with pytest.raises(ValueError, match="SAME"):
             F.conv2d_transpose(x, w, stride=4, padding="SAME")
+
+
+class TestConvTransposeOutputSize:
+    def test_output_size_selects_output_padding(self):
+        x = paddle.to_tensor(np.zeros((1, 3, 8, 8), np.float32))
+        w = paddle.to_tensor(np.zeros((3, 4, 3, 3), np.float32))
+        # base out = (8-1)*2 + 3 = 17; output_size 18 => opad 1
+        y = F.conv2d_transpose(x, w, stride=2, padding=0,
+                               output_size=[18, 18])
+        assert list(y.shape) == [1, 4, 18, 18]
+
+    def test_unreachable_output_size_rejected(self):
+        x = paddle.to_tensor(np.zeros((1, 3, 8, 8), np.float32))
+        w = paddle.to_tensor(np.zeros((3, 4, 3, 3), np.float32))
+        with pytest.raises(ValueError, match="output_size"):
+            F.conv2d_transpose(x, w, stride=2, padding=0,
+                               output_size=[25, 25])
+
+
+def test_sparse_attention_masks_rejected():
+    q = paddle.to_tensor(np.ones((1, 1, 2, 4), np.float32))
+    off = paddle.to_tensor(np.array([[[0, 1, 2]]], np.int64))
+    col = paddle.to_tensor(np.array([[[0, 1]]], np.int64))
+    with pytest.raises(NotImplementedError, match="CSR"):
+        F.sparse_attention(q, q, q, off, col, key_padding_mask=q)
