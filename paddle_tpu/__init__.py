@@ -68,6 +68,22 @@ from . import text  # noqa: F401
 from . import vision  # noqa: F401
 from .autograd import PyLayer, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from .core.selected_rows import SelectedRows  # noqa: F401
+from .tensor.extras import (  # noqa: F401
+    as_complex, as_real, cast, cdist, check_shape, frexp, mv, pdist,
+    reduce_as, renorm, renorm_, sgn, standard_gamma, tensordot, tolist,
+    vander)
+from .tensor.scatter_views import (  # noqa: F401
+    combinations, diagonal_scatter, masked_scatter, masked_scatter_,
+    select_scatter, slice_scatter, unfold)
+from .tensor.inplace import *  # noqa: F401,F403
+from .framework import (  # noqa: F401
+    LazyGuard, batch, create_parameter, disable_signal_handler, finfo,
+    get_cuda_rng_state, iinfo, set_cuda_rng_state, set_printoptions)
+from .tensor.manipulation import flip as reverse  # noqa: F401
+from .device import CUDAPinnedPlace  # noqa: F401
+from .nn.functional.init_utils import ParamAttr  # noqa: F401
+import numpy as _np
+dtype = _np.dtype  # paddle.dtype: dtype objects are numpy/ml_dtypes dtypes
 from .device import (CPUPlace, CUDAPlace, TPUPlace, XPUPlace, get_device,  # noqa: F401
                      is_compiled_with_cinn, is_compiled_with_cuda,
                      is_compiled_with_distribute, is_compiled_with_rocm,

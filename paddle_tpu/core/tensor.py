@@ -362,9 +362,12 @@ class Tensor:
         if isinstance(self._data, jax.core.Tracer):
             return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
                     f"<traced>)")
+        from ..framework import PRINT_OPTIONS
+        body = (np.array2string(np.asarray(self._data), **PRINT_OPTIONS)
+                if PRINT_OPTIONS else repr(np.asarray(self._data)))
         return (f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, "
                 f"stop_gradient={self.stop_gradient},\n"
-                f"       {np.asarray(self._data)!r})")
+                f"       {body})")
 
     __str__ = __repr__
 

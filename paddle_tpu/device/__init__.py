@@ -48,6 +48,16 @@ class TPUPlace(Place):
 
 # compat alias: code written against CUDAPlace runs on TPU
 CUDAPlace = TPUPlace
+
+
+class CUDAPinnedPlace(Place):
+    """Pinned-host-memory place (reference: CUDAPinnedPlace). TPU analogue:
+    plain host memory — jax device_put from numpy already uses pinned
+    staging buffers internally."""
+
+    def __init__(self):
+        super().__init__("cpu_pinned", 0)
+
 XPUPlace = TPUPlace
 CustomPlace = TPUPlace
 

@@ -96,3 +96,116 @@ def in_dynamic_or_pir_mode():
 
 def use_pir_api():
     return False
+
+
+# -- namespace-parity utilities (reference: python/paddle/framework/) -------
+class finfo:
+    """paddle.finfo (reference: python/paddle/framework/dtype.py finfo) —
+    float-dtype limits via jnp/ml_dtypes (covers bfloat16/fp8 natively)."""
+
+    def __init__(self, dtype):
+        import jax.numpy as jnp
+
+        from ..core.dtype import convert_dtype
+        fi = jnp.finfo(convert_dtype(dtype))
+        self.dtype = str(fi.dtype)
+        self.bits = fi.bits
+        self.eps = float(fi.eps)
+        self.min = float(fi.min)
+        self.max = float(fi.max)
+        self.tiny = float(fi.tiny)
+        self.smallest_normal = float(fi.tiny)
+        self.resolution = float(fi.resolution)
+
+
+class iinfo:
+    """paddle.iinfo — integer-dtype limits."""
+
+    def __init__(self, dtype):
+        import jax.numpy as jnp
+
+        from ..core.dtype import convert_dtype
+        ii = jnp.iinfo(convert_dtype(dtype))
+        self.dtype = str(ii.dtype)
+        self.bits = ii.bits
+        self.min = int(ii.min)
+        self.max = int(ii.max)
+
+
+# Tensor-repr formatting options, scoped to Tensor.__repr__ only (the
+# reference likewise formats only Tensor __str__, never global numpy state)
+PRINT_OPTIONS: dict = {}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Configure how Tensors print (reference:
+    python/paddle/tensor/to_string.py set_printoptions).  Affects only
+    Tensor reprs — the user's own numpy print options are untouched."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["max_line_width"] = linewidth  # np.array2string's name for it
+    if sci_mode is not None:
+        kw["suppress_small"] = not sci_mode
+    PRINT_OPTIONS.clear()
+    PRINT_OPTIONS.update(kw)
+
+
+class LazyGuard:
+    """reference: python/paddle/nn/initializer/lazy_init.py — defers param
+    materialisation.  Params here are cheap jnp arrays initialised on
+    construction; the guard is a no-op context kept for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def disable_signal_handler():
+    """reference: installs/removes C++ signal handlers; no native signal
+    handlers exist in this runtime — no-op."""
+
+
+def get_cuda_rng_state():
+    """Device RNG state (the single JAX PRNG key doubles as the 'cuda'
+    generator state)."""
+    from ..tensor.random import get_rng_state
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from ..tensor.random import set_rng_state
+    set_rng_state(state)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference: python/paddle/tensor/creation.py create_parameter."""
+    from ..nn.functional.init_utils import param_attr_init
+    p = param_attr_init(shape, dtype, attr, is_bias, default_initializer)
+    if name:
+        p.name = name
+    return p
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference: python/paddle/reader (deprecated) — batch a sample
+    generator."""
+    def gen():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return gen

@@ -113,6 +113,10 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
     else:
         pads = _padding(padding, spatial)
     if output_size is not None:
+        if any(o != 0 for o in opad):
+            raise ValueError(
+                f"{op_name}: output_padding is mutually exclusive with "
+                "output_size (reference conv.py raises the same)")
         # reference semantics: output_size disambiguates the
         # stride-ambiguous output dim by choosing output_padding
         # (conv2d_transpose docs: out default + opad, 0 <= opad < stride)

@@ -1,0 +1,161 @@
+"""Breadth ops rounding out the paddle.* namespace (reference:
+python/paddle/tensor/math.py + linalg.py entries not covered by the YAML
+corpus — cast/sgn/frexp/renorm/reduce_as/mv/tensordot/vander/cdist/pdist/
+standard_gamma)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import apply_op, matmul_precision
+from ..core.tensor import Tensor
+from ..ops._runtime import _t
+
+
+def cast(x, dtype):
+    """paddle.cast (reference: tensor/manipulation.py cast -> cast kernel;
+    AMP-exempt so explicit casts are never overridden)."""
+    dt = dtypes.convert_dtype(dtype)
+    return apply_op("cast", lambda v: v.astype(dt), _t(x), amp=False)
+
+
+def sgn(x, name=None):
+    """sign for real dtypes; x/|x| (0 -> 0) for complex."""
+    x = _t(x)
+    if jnp.issubdtype(x._data.dtype, jnp.complexfloating):
+        def fn(v):
+            a = jnp.abs(v)
+            return jnp.where(a == 0, 0.0 + 0.0j, v / jnp.where(a == 0, 1.0,
+                                                               a))
+        return apply_op("sgn", fn, x)
+    return apply_op("sgn", jnp.sign, x)
+
+
+def frexp(x, name=None):
+    """(mantissa, exponent) with x = mantissa * 2**exponent,
+    |mantissa| in [0.5, 1)."""
+    m, e = jnp.frexp(_t(x)._data)
+    return Tensor._wrap(m), Tensor._wrap(e.astype(jnp.int32))
+
+
+def mv(x, vec, name=None):
+    return apply_op("mv",
+                    lambda a, b: jnp.matmul(a, b,
+                                            precision=matmul_precision()),
+                    _t(x), _t(vec))
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    return apply_op("tensordot",
+                    lambda a, b: jnp.tensordot(
+                        a, b, axes=axes, precision=matmul_precision()),
+                    _t(x), _t(y))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op("vander",
+                    lambda v: jnp.vander(v, N=n, increasing=increasing),
+                    _t(x))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Scale each sub-tensor along ``axis`` whose p-norm exceeds max_norm
+    down to max_norm (reference: renorm kernel)."""
+    def fn(v):
+        m = jnp.moveaxis(v, axis, 0).reshape(v.shape[axis], -1)
+        norms = jnp.sum(jnp.abs(m) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = m * scale[:, None]
+        return jnp.moveaxis(out.reshape(jnp.moveaxis(v, axis, 0).shape), 0,
+                            axis)
+    return apply_op("renorm", fn, _t(x))
+
+
+def renorm_(x, p, axis, max_norm, name=None):
+    return x._inplace_assign(renorm(x, p, axis, max_norm))
+
+
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (the broadcast adjoint; reference:
+    reduce_as op)."""
+    tshape = tuple(int(s) for s in (target.shape if hasattr(target, "shape")
+                                    else target))
+
+    def fn(v):
+        extra = v.ndim - len(tshape)
+        if extra:
+            v = v.sum(axis=tuple(range(extra)))
+        keep = tuple(i for i, (a, b) in enumerate(zip(v.shape, tshape))
+                     if a != b)
+        return v.sum(axis=keep, keepdims=True) if keep else v
+    return apply_op("reduce_as", fn, _t(x))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-distance between row batches [..., n, d] x [..., m, d]."""
+    def fn(a, b):
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0:
+            return (diff != 0).sum(-1).astype(a.dtype)
+        if jnp.isinf(p):
+            return diff.max(-1)
+        return (diff ** p).sum(-1) ** (1.0 / p)
+    return apply_op("cdist", fn, _t(x), _t(y))
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distance of rows [n, d] -> [n*(n-1)/2]."""
+    n = int(x.shape[0])
+    iu = np.triu_indices(n, k=1)
+
+    def fn(a):
+        d = jnp.abs(a[:, None, :] - a[None, :, :])
+        full = (d.max(-1) if jnp.isinf(p)
+                else (d ** p).sum(-1) ** (1.0 / p))
+        return full[iu]
+    return apply_op("pdist", fn, _t(x))
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, scale=1) elementwise (reference:
+    standard_gamma op over the Marsaglia-Tsang sampler; here
+    jax.random.gamma)."""
+    from .random import _next_key
+    return Tensor._wrap(jax.random.gamma(_next_key(), _t(x)._data))
+
+
+def as_complex(x, name=None):
+    """[..., 2] float -> [...] complex (reference: as_complex kernel)."""
+    return apply_op("as_complex",
+                    lambda v: jax.lax.complex(v[..., 0], v[..., 1]), _t(x))
+
+
+def as_real(x, name=None):
+    """[...] complex -> [..., 2] float."""
+    return apply_op("as_real",
+                    lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], -1),
+                    _t(x))
+
+
+def tolist(x):
+    return _t(x).tolist()
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference: utils checker) — ints or a
+    1-D int tensor; -1 allowed once."""
+    vals = shape.tolist() if isinstance(shape, Tensor) else list(shape)
+    if sum(1 for v in vals if int(v) == -1) > 1:
+        raise ValueError(f"shape {vals} has more than one -1")
+    for v in vals:
+        if int(v) < -1:
+            raise ValueError(f"shape {vals}: dims must be >= -1")
+    return vals

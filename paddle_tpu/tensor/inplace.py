@@ -1,0 +1,126 @@
+"""Inplace (trailing-underscore) op variants.
+
+Reference analogue: the ``inplace:`` annotations in phi/ops/yaml/ops.yaml
+generate ``op_``(x) twins sharing x's buffer.  TPU-native: XLA arrays are
+immutable, so ``op_`` computes functionally and rebinds the tensor's buffer
+via ``Tensor._inplace_assign`` — when the old buffer is dead XLA reuses it,
+which is the same memory behavior the reference's inplace pass buys, without
+aliasing hazards under autograd (assign raises if x needs grad and the op
+would invalidate the tape, matching dygraph's inplace check).
+"""
+
+from __future__ import annotations
+
+from ..ops import _generated as _g
+from . import extras as _extras
+from . import logic as _logic
+
+
+def _mk(name, fn, n_tensor_args=1):
+    def op(x, *args, **kwargs):
+        return x._inplace_assign(fn(x, *args, **kwargs))
+    op.__name__ = name
+    return op
+
+
+_UNARY = [
+    "abs", "acos", "asin", "atan", "ceil", "cos", "cosh", "digamma", "erf",
+    "exp", "expm1", "floor", "frac", "i0", "lgamma", "log", "log10",
+    "log1p", "log2", "logit", "nan_to_num", "neg", "reciprocal", "round",
+    "rsqrt", "sigmoid", "sign", "sin", "sinc", "sinh", "sqrt", "square",
+    "tan", "tanh", "trunc", "gammaln",
+]
+_BINARY = [
+    "add", "subtract", "multiply", "divide", "remainder", "floor_divide",
+    "pow", "copysign", "hypot", "ldexp", "fmax", "fmin", "maximum",
+    "minimum", "gcd", "lcm", "heaviside", "nextafter", "atan2",
+    "logaddexp", "gammainc", "gammaincc",
+]
+_LOGIC = [
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift", "equal", "not_equal",
+    "less_than", "less_equal", "greater_than", "greater_equal",
+]
+
+__all__ = []
+for _n in _UNARY + _BINARY:
+    globals()[_n + "_"] = _mk(_n + "_", getattr(_g, _n))
+    __all__.append(_n + "_")
+for _n in _LOGIC:
+    globals()[_n + "_"] = _mk(_n + "_", getattr(_logic, _n))
+    __all__.append(_n + "_")
+
+# aliases and non-YAML members
+mod_ = remainder_  # noqa: F821
+floor_mod_ = remainder_  # noqa: F821
+__all__ += ["mod_", "floor_mod_"]
+
+
+def cast_(x, dtype):
+    return x._inplace_assign(_extras.cast(x, dtype))
+
+
+def erfinv_(x, name=None):
+    return x._inplace_assign(_g.erfinv(x))
+
+
+def cumsum_(x, axis=None, dtype=None, name=None):
+    from .math import cumsum
+    return x._inplace_assign(cumsum(x, axis, dtype))
+
+
+def cumprod_(x, dim=None, dtype=None, name=None):
+    from .math import cumprod
+    return x._inplace_assign(cumprod(x, dim, dtype))
+
+
+def clip_(x, min=None, max=None, name=None):
+    return x._inplace_assign(_g.clip(x, min, max))
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+           name=None):
+    return x._inplace_assign(_g.scale(x, scale, bias, bias_after_scale))
+
+
+def addmm_(input, x, y, beta=1.0, alpha=1.0, name=None):
+    from .math import addmm
+    return input._inplace_assign(addmm(input, x, y, beta, alpha))
+
+
+def tril_(x, diagonal=0, name=None):
+    from .creation import tril
+    return x._inplace_assign(tril(x, diagonal))
+
+
+def triu_(x, diagonal=0, name=None):
+    from .creation import triu
+    return x._inplace_assign(triu(x, diagonal))
+
+
+def t_(x, name=None):
+    from .linalg import t
+    return x._inplace_assign(t(x))
+
+
+def where_(condition, x, y, name=None):
+    """In-place where: x <- where(condition, x, y)."""
+    from .search import where
+    return x._inplace_assign(where(condition, x, y))
+
+
+def divide_no_nan_(x, y, name=None):
+    return x._inplace_assign(_g.divide_no_nan(x, y))
+
+
+def polygamma_(x, n=1, name=None):
+    return x._inplace_assign(_g.polygamma(x, n))
+
+
+def multigammaln_(x, p=1, name=None):
+    return x._inplace_assign(_g.multigammaln(x, p))
+
+
+__all__ += ["polygamma_", "multigammaln_", "cast_", "erfinv_", "cumsum_", "cumprod_", "clip_", "scale_",
+            "addmm_", "tril_", "triu_", "t_", "where_", "divide_no_nan_"]

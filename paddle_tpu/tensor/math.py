@@ -30,11 +30,13 @@ from ..ops._generated import (  # noqa: F401
     abs, acos, acosh, add, asin, asinh, atan, atan2, atanh, ceil, clip,
     copysign, cos, cosh, digamma, divide, divide_no_nan, erf, erfinv, exp,
     expm1, floor, floor_divide, fmax, fmin, frac, gamma, gcd, heaviside,
-    deg2rad, exponent, hypot, i0, i0e, i1, i1e, isfinite, isinf, isnan, lcm,
-    ldexp, lgamma, log, log1p, log2, log10, logaddexp, logit, maximum,
-    minimum, multiply, nan_to_num, neg, negative, nextafter, pow, rad2deg,
-    reciprocal, remainder, round, rsqrt, scale, sigmoid, sign, sin, sinh,
-    sqrt, square, stanh, subtract, tan, tanh, trunc,
+    deg2rad, exponent, gammainc, gammaincc, gammaln, hypot, i0, i0e, i1,
+    i1e, isfinite, isinf, isnan, isneginf, isposinf, isreal, lcm, ldexp,
+    lgamma, log, log1p, log2, log10, logaddexp, logit, maximum, minimum,
+    multigammaln, multiply, nan_to_num, neg, negative, nextafter,
+    polygamma, pow, rad2deg, reciprocal, remainder, round, rsqrt, scale,
+    sigmoid, sign, signbit, sin, sinc, sinh, sqrt, square, stanh, subtract,
+    tan, tanh, trunc,
 )
 from ..ops._generated import (  # noqa: F401
     all, amax, amin, any, count_nonzero, logsumexp, max, mean, min, nanmean,

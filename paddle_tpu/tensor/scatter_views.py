@@ -1,0 +1,99 @@
+"""Scatter-view and windowing ops (reference: python/paddle/tensor/
+manipulation.py diagonal_scatter/select_scatter/slice_scatter/unfold/
+masked_scatter — there thin wrappers over set_value/strided kernels; here
+each is one jnp ``.at[...]`` functional update or gather, which XLA lowers
+to an in-place scatter when the input buffer is dead)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..ops._runtime import _t
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write ``y`` onto the (offset) diagonal of x over (axis1, axis2)."""
+    def fn(v, s):
+        m = jnp.moveaxis(v, (axis1, axis2), (-2, -1))
+        h, w = m.shape[-2], m.shape[-1]
+        n = s.shape[-1]
+        r = jnp.arange(n) + (-offset if offset < 0 else 0)
+        c = jnp.arange(n) + (offset if offset > 0 else 0)
+        m = m.at[..., r, c].set(jnp.moveaxis(s, -1, -1))
+        return jnp.moveaxis(m, (-2, -1), (axis1, axis2))
+    return apply_op("diagonal_scatter", fn, _t(x), _t(y))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """Write ``values`` into slice ``index`` along ``axis``."""
+    def fn(v, s):
+        sl = (slice(None),) * (axis % v.ndim) + (index,)
+        return v.at[sl].set(s)
+    return apply_op("select_scatter", fn, _t(x), _t(values))
+
+
+def slice_scatter(x, value, axes=(), starts=(), ends=(), strides=(),
+                  name=None):
+    """Write ``value`` into the strided slice of x described by
+    axes/starts/ends/strides."""
+    def fn(v, s):
+        sl = [slice(None)] * v.ndim
+        for ax, st, en, sr in zip(axes, starts, ends, strides):
+            sl[ax] = slice(int(st), int(en), int(sr))
+        return v.at[tuple(sl)].set(s)
+    return apply_op("slice_scatter", fn, _t(x), _t(value))
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows of ``size`` every ``step`` along ``axis``; windows
+    land in a new trailing dim (torch/paddle unfold contract)."""
+    x = _t(x)
+    length = int(x.shape[axis])
+    n_win = (length - size) // step + 1
+    if n_win <= 0:
+        raise ValueError(f"unfold: size {size} > dim {length}")
+    idx = (np.arange(n_win)[:, None] * step
+           + np.arange(size)[None, :])            # [n_win, size]
+
+    def fn(v):
+        g = jnp.take(v, jnp.asarray(idx.reshape(-1)), axis=axis)
+        g = jnp.moveaxis(g, axis, -1)
+        g = g.reshape(g.shape[:-1] + (n_win, size))
+        return jnp.moveaxis(g, -2, axis)
+    return apply_op("unfold", fn, x)
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill x's True-masked positions with consecutive elements of
+    ``value`` (row-major)."""
+    def fn(v, m, s):
+        m = jnp.broadcast_to(m, v.shape)
+        pos = jnp.cumsum(m.reshape(-1)) - 1       # k-th True -> value[k]
+        picked = jnp.take(s.reshape(-1),
+                          jnp.clip(pos, 0, s.size - 1)).reshape(v.shape)
+        return jnp.where(m, picked.astype(v.dtype), v)
+    return apply_op("masked_scatter", fn, _t(x), _t(mask), _t(value))
+
+
+def masked_scatter_(x, mask, value, name=None):
+    return x._inplace_assign(masked_scatter(x, mask, value))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor's elements (itertools semantics;
+    index set is static, the gather is traceable)."""
+    import itertools
+
+    x = _t(x)
+    n = int(x.shape[0])
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(it), np.int32).reshape(-1, r)
+
+    def fn(v):
+        return v[jnp.asarray(idx)]
+    return apply_op("combinations", fn, x)

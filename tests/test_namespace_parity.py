@@ -1,0 +1,208 @@
+"""Top-level namespace parity vs the reference's paddle.__all__.
+
+The reference's python/paddle/__init__.py exports 410 public names; every
+one must resolve on paddle_tpu (the "switch frameworks and find everything"
+criterion).  Plus behavior checks for the names added to close the gap
+(inplace variants, scatter views, distance ops, framework utilities).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+REF_INIT = "/root/reference/python/paddle/__init__.py"
+
+
+def test_reference_all_fully_covered():
+    src = open(REF_INIT).read()
+    block = re.search(r"__all__ = \[(.*?)\]", src, re.S).group(1)
+    ref_names = set(re.findall(r"'([^']+)'", block))
+    assert len(ref_names) > 350  # sanity: parsed the real list
+    missing = sorted(n for n in ref_names if not hasattr(paddle, n))
+    assert missing == [], f"missing from paddle_tpu: {missing}"
+
+
+class TestInplaceVariants:
+    def test_unary_inplace_rebinds(self):
+        x = paddle.to_tensor(np.array([1.0, 4.0], np.float32))
+        out = paddle.sqrt_(x)
+        assert out is x
+        np.testing.assert_allclose(x.numpy(), [1.0, 2.0])
+
+    def test_binary_inplace(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        paddle.add_(x, paddle.to_tensor(np.array([10.0, 20.0], np.float32)))
+        np.testing.assert_allclose(x.numpy(), [11.0, 22.0])
+
+    def test_cast_(self):
+        x = paddle.to_tensor(np.array([1.5], np.float32))
+        paddle.cast_(x, "int32")
+        assert "int32" in str(x.numpy().dtype)
+
+    def test_where_(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        cond = paddle.to_tensor(np.array([True, False]))
+        paddle.where_(cond, x, paddle.to_tensor(np.array([9.0, 9.0],
+                                                         np.float32)))
+        np.testing.assert_allclose(x.numpy(), [1.0, 9.0])
+
+
+class TestScatterViews:
+    def test_select_scatter(self):
+        x = paddle.to_tensor(np.zeros((3, 2), np.float32))
+        out = paddle.select_scatter(
+            x, paddle.to_tensor(np.ones(2, np.float32)), axis=0, index=1)
+        np.testing.assert_allclose(out.numpy()[1], 1.0)
+        np.testing.assert_allclose(out.numpy()[[0, 2]], 0.0)
+
+    def test_slice_scatter(self):
+        x = paddle.to_tensor(np.zeros((4, 4), np.float32))
+        v = paddle.to_tensor(np.ones((2, 4), np.float32))
+        out = paddle.slice_scatter(x, v, axes=[0], starts=[1], ends=[3],
+                                   strides=[1])
+        np.testing.assert_allclose(out.numpy()[1:3], 1.0)
+
+    def test_diagonal_scatter_matches_numpy(self):
+        x = paddle.to_tensor(np.zeros((3, 3), np.float32))
+        out = paddle.diagonal_scatter(
+            x, paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+        np.testing.assert_allclose(np.diag(out.numpy()), [1, 2, 3])
+
+    def test_unfold(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        out = paddle.unfold(x, axis=0, size=3, step=2)
+        np.testing.assert_allclose(out.numpy(), [[0, 1, 2], [2, 3, 4]])
+
+    def test_masked_scatter(self):
+        x = paddle.to_tensor(np.zeros(4, np.float32))
+        mask = paddle.to_tensor(np.array([True, False, True, False]))
+        out = paddle.masked_scatter(
+            x, mask, paddle.to_tensor(np.array([7.0, 8.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [7, 0, 8, 0])
+
+    def test_combinations(self):
+        x = paddle.to_tensor(np.array([10.0, 20.0, 30.0], np.float32))
+        out = paddle.combinations(x, r=2).numpy()
+        np.testing.assert_allclose(out, [[10, 20], [10, 30], [20, 30]])
+
+
+class TestExtras:
+    def test_cdist_pdist(self):
+        from scipy.spatial.distance import cdist as sc_cdist
+        from scipy.spatial.distance import pdist as sc_pdist
+        rng = np.random.RandomState(0)
+        a = rng.rand(4, 3).astype(np.float32)
+        b = rng.rand(5, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            sc_cdist(a, b), atol=1e-5)
+        np.testing.assert_allclose(
+            paddle.pdist(paddle.to_tensor(a)).numpy(), sc_pdist(a),
+            atol=1e-5)
+
+    def test_frexp_roundtrip(self):
+        x = np.array([0.75, 6.0, -3.0], np.float32)
+        m, e = paddle.frexp(paddle.to_tensor(x))
+        np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), x,
+                                   rtol=1e-6)
+
+    def test_tensordot_matches_numpy(self):
+        rng = np.random.RandomState(1)
+        a = rng.rand(2, 3, 4).astype(np.float32)
+        b = rng.rand(4, 3, 5).astype(np.float32)
+        got = paddle.tensordot(paddle.to_tensor(a), paddle.to_tensor(b),
+                               axes=[[2], [0]]).numpy()
+        np.testing.assert_allclose(got, np.tensordot(a, b, axes=([2], [0])),
+                                   atol=1e-5)
+
+    def test_renorm_caps_norms(self):
+        x = paddle.to_tensor(np.array([[3.0, 4.0], [0.3, 0.4]], np.float32))
+        out = paddle.renorm(x, p=2.0, axis=0, max_norm=1.0).numpy()
+        np.testing.assert_allclose(np.linalg.norm(out[0]), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(out[1], [0.3, 0.4], rtol=1e-5)  # untouched
+
+    def test_reduce_as(self):
+        x = paddle.to_tensor(np.ones((2, 3, 4), np.float32))
+        t = paddle.to_tensor(np.ones((3, 1), np.float32))
+        out = paddle.reduce_as(x, t)
+        assert list(out.shape) == [3, 1]
+        np.testing.assert_allclose(out.numpy(), 8.0)
+
+    def test_as_complex_real_roundtrip(self):
+        x = np.random.RandomState(0).rand(3, 2).astype(np.float32)
+        c = paddle.as_complex(paddle.to_tensor(x))
+        back = paddle.as_real(c).numpy()
+        np.testing.assert_allclose(back, x, atol=1e-6)
+
+    def test_sgn_complex(self):
+        z = np.array([3 + 4j, 0 + 0j], np.complex64)
+        out = paddle.sgn(paddle.to_tensor(z)).numpy()
+        np.testing.assert_allclose(out[0], 0.6 + 0.8j, atol=1e-6)
+        np.testing.assert_allclose(out[1], 0.0, atol=1e-7)
+
+    def test_vander(self):
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            paddle.vander(paddle.to_tensor(x)).numpy(), np.vander(x))
+
+    def test_standard_gamma_positive(self):
+        alpha = paddle.to_tensor(np.full((100,), 2.0, np.float32))
+        s = paddle.standard_gamma(alpha).numpy()
+        assert (s > 0).all() and 1.0 < s.mean() < 3.5  # E[Gamma(2,1)] = 2
+
+
+class TestFrameworkUtils:
+    def test_finfo_iinfo(self):
+        fi = paddle.finfo(paddle.bfloat16)
+        assert fi.bits == 16 and fi.max > 3e38
+        ii = paddle.iinfo(paddle.int32)
+        assert ii.max == 2**31 - 1
+
+    def test_create_parameter(self):
+        p = paddle.create_parameter([2, 3], "float32")
+        assert not p.stop_gradient and list(p.shape) == [2, 3]
+
+    def test_batch(self):
+        r = paddle.batch(lambda: iter(range(5)), batch_size=2)
+        assert list(r()) == [[0, 1], [2, 3], [4]]
+        r = paddle.batch(lambda: iter(range(5)), batch_size=2,
+                         drop_last=True)
+        assert list(r()) == [[0, 1], [2, 3]]
+
+    def test_check_shape(self):
+        assert paddle.check_shape([2, -1, 3]) == [2, -1, 3]
+        with pytest.raises(ValueError):
+            paddle.check_shape([-1, -1])
+
+    def test_lazy_guard_and_misc(self):
+        with paddle.LazyGuard():
+            lin = paddle.nn.Linear(2, 2)
+        assert lin.parameters()
+        paddle.disable_signal_handler()
+        st = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(st)
+
+
+def test_set_printoptions_scoped_to_tensor_repr():
+    import numpy as np
+    before = np.get_printoptions()
+    paddle.set_printoptions(precision=2, sci_mode=False)
+    try:
+        t = paddle.to_tensor(np.array([1.23456789e-5], np.float32))
+        assert "1.23456789" not in repr(t)
+        # the user's numpy formatting is untouched
+        assert np.get_printoptions() == before
+    finally:
+        paddle.set_printoptions()  # reset
+
+
+def test_output_size_and_output_padding_mutually_exclusive():
+    import paddle_tpu.nn.functional as F
+    x = paddle.to_tensor(np.zeros((1, 3, 8, 8), np.float32))
+    w = paddle.to_tensor(np.zeros((3, 4, 3, 3), np.float32))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        F.conv2d_transpose(x, w, stride=2, output_padding=1,
+                           output_size=[17, 17])
