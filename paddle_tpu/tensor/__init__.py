@@ -7,8 +7,9 @@ list)."""
 from __future__ import annotations
 
 from ..core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
-from . import (attribute, creation, einsum as einsum_mod, linalg, logic, manipulation,
-               math, random, search, stat)
+from . import (attribute, creation, einsum as einsum_mod, extras, inplace,
+               linalg, logic, manipulation, math, random, scatter_views,
+               search, stat)
 from .attribute import *  # noqa: F401,F403
 from .creation import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
@@ -22,9 +23,10 @@ from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 
-_METHOD_SOURCES = [math, manipulation, linalg, logic, search, stat, attribute]
+_METHOD_SOURCES = [math, manipulation, linalg, logic, search, stat,
+                   attribute, extras, inplace, scatter_views]
 
-_SKIP = {"to_tensor", "Tensor", "Parameter", "builtins_sum", "builtins_slice"}
+_SKIP = {"check_shape"}  # shape validator, not a Tensor op
 
 
 def _attach_methods():
@@ -35,6 +37,14 @@ def _attach_methods():
                 continue
             fn = getattr(mod, name)
             if not isinstance(fn, types.FunctionType):
+                continue
+            m = getattr(fn, "__module__", "") or ""
+            # only op functions become methods: infra helpers a module
+            # merely imports (core.dispatch.apply_op/matmul_precision,
+            # core.tensor.to_tensor, numpy/jax callables) must not leak
+            # onto the Tensor API
+            if not m.startswith("paddle_tpu.") or m.startswith(
+                    "paddle_tpu.core."):
                 continue
             if not hasattr(Tensor, name):
                 setattr(Tensor, name, fn)

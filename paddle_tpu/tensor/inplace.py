@@ -104,8 +104,12 @@ def t_(x, name=None):
     return x._inplace_assign(t(x))
 
 
-def where_(condition, x, y, name=None):
-    """In-place where: x <- where(condition, x, y)."""
+def where_(condition, x=None, y=None, name=None):
+    """In-place where: x <- where(condition, x, y).  Method binding puts
+    self on `condition` (reference math_op_patch attaches it plainly, so
+    cond.where_(x, y) mutates x)."""
+    if x is None or y is None:
+        raise ValueError("where_ requires both x and y")
     from .search import where
     return x._inplace_assign(where(condition, x, y))
 

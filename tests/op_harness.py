@@ -116,14 +116,17 @@ def _run_sharded(case, arrays):
     run of op_test (same op, different placement, same numbers)."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     from paddle_tpu.core.state import STATE
     from paddle_tpu.core.tensor import Tensor
-    from paddle_tpu.distributed.env import get_mesh, build_mesh
+    from paddle_tpu.distributed.env import get_mesh
 
     mesh = get_mesh()
     if mesh is None or mesh.shape.get("dp", 1) == 1:
-        mesh = build_mesh({"dp": jax.device_count()})
+        # LOCAL mesh — must not register globally (a global dp-mesh leaks
+        # into later single-chip tests, which then fail batch-divisibility
+        # sharding constraints; bit us in the round-5 full-suite run)
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
     dp = mesh.shape["dp"]
     placed = []
     for x in arrays:

@@ -139,12 +139,11 @@ class TestSpmdRules:
     def _gspmd_out_spec(self, fn, arrays, in_specs):
         import jax
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding
+        from jax.sharding import Mesh, NamedSharding
 
-        from paddle_tpu.distributed.env import build_mesh, get_mesh
-        mesh = get_mesh()
-        if mesh is None or "dp" not in mesh.shape:
-            mesh = build_mesh({"dp": jax.device_count()})
+        # local mesh only — registering a global dp-mesh leaks into later
+        # single-chip tests (see op_harness._run_sharded)
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
         placed = [jax.device_put(jnp.asarray(a), NamedSharding(mesh, s))
                   for a, s in zip(arrays, in_specs)]
         out = jax.jit(fn)(*placed)

@@ -206,3 +206,26 @@ def test_output_size_and_output_padding_mutually_exclusive():
     with pytest.raises(ValueError, match="mutually exclusive"):
         F.conv2d_transpose(x, w, stride=2, output_padding=1,
                            output_size=[17, 17])
+
+
+def test_where_method_binds_condition_like_reference():
+    """reference math_op_patch attaches where_ plainly, so
+    cond.where_(x, y) == where(cond, x, y) written in-place into x."""
+    cond = paddle.to_tensor(np.array([True, False]))
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    y = paddle.to_tensor(np.array([9.0, 9.0], np.float32))
+    out = cond.where_(x, y)
+    assert out is x
+    np.testing.assert_allclose(x.numpy(), [1.0, 9.0])
+    with pytest.raises(ValueError, match="both"):
+        cond.where_(x)
+
+
+def test_no_infra_helpers_leak_onto_tensor():
+    from paddle_tpu.core.tensor import Tensor
+    for bad in ("matmul_precision", "apply_op", "to_tensor",
+                "check_shape"):
+        assert not hasattr(Tensor, bad), bad
+    # op methods from every source module still attach
+    for good in ("exp", "cdist", "unfold", "sqrt_", "masked_scatter"):
+        assert hasattr(Tensor, good), good
