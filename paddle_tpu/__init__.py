@@ -80,6 +80,10 @@ from .framework import (  # noqa: F401
     LazyGuard, batch, create_parameter, disable_signal_handler, finfo,
     get_cuda_rng_state, iinfo, set_cuda_rng_state, set_printoptions)
 from .tensor.manipulation import flip as reverse  # noqa: F401
+from .tensor.creation import create_tensor  # noqa: F401
+from .tensor.linalg import ormqr, svd_lowrank  # noqa: F401
+from .tensor.search import top_p_sampling  # noqa: F401
+from .tensor.random import cauchy_, geometric_  # noqa: F401
 from .device import CUDAPinnedPlace  # noqa: F401
 from .nn.functional.init_utils import ParamAttr  # noqa: F401
 import numpy as _np

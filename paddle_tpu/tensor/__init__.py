@@ -26,7 +26,9 @@ from .stat import *  # noqa: F401,F403
 _METHOD_SOURCES = [math, manipulation, linalg, logic, search, stat,
                    attribute, extras, inplace, scatter_views]
 
-_SKIP = {"check_shape"}  # shape validator, not a Tensor op
+# non-op helpers defined inside op modules (so the __module__ filter below
+# cannot catch them)
+_SKIP = {"check_shape", "builtins_sum", "builtins_slice"}
 
 
 def _attach_methods():
@@ -59,6 +61,25 @@ def _attach_methods():
     Tensor.chunk = manipulation.chunk
     Tensor.topk = search.topk
     Tensor.einsum = lambda self, eq, *others: einsum(eq, self, *others)
+    # names the reference attaches from modules outside _METHOD_SOURCES
+    # (creation/signal/random/framework; reference tensor_method_func list)
+    from ..signal import istft as _istft, stft as _stft
+    from ..framework import create_parameter as _create_parameter
+    from .creation import diag, diagflat, tril, triu
+    Tensor.tril = tril
+    Tensor.triu = triu
+    Tensor.diag = diag
+    Tensor.diagflat = diagflat
+    Tensor.stft = _stft
+    Tensor.istft = _istft
+    Tensor.multinomial = random.multinomial
+    Tensor.reverse = manipulation.flip
+    Tensor.create_parameter = staticmethod(_create_parameter)
+    Tensor.create_tensor = staticmethod(create_tensor)
+    from .creation import polar as _polar
+    Tensor.polar = _polar
+    Tensor.cauchy_ = random.cauchy_
+    Tensor.geometric_ = random.geometric_
 
     def _add_(self, y, alpha=1):
         return self._inplace_assign(self + (y * alpha if alpha != 1 else y))

@@ -156,15 +156,7 @@ def polar(abs_t, angle, name=None):
                     abs_t, angle)
 
 
-def cauchy_(x, loc=0, scale=1, name=None):
-    from .random import _next_key
-    u = jax.random.uniform(_next_key(), x._data.shape) - 0.5
-    x._data = (loc + scale * jnp.tan(np.pi * u)).astype(x.dtype)
-    return x
-
-
-def geometric_(x, probs, name=None):
-    from .random import _next_key
-    u = jax.random.uniform(_next_key(), x._data.shape)
-    x._data = (jnp.floor(jnp.log1p(-u) / jnp.log1p(-probs)) + 1).astype(x.dtype)
-    return x
+def create_tensor(dtype="float32", name=None, persistable=False):
+    """reference: tensor/creation.py create_tensor — an empty typed holder
+    (static-mode legacy); here a 0-size tensor of the dtype."""
+    return Tensor._wrap(jnp.zeros((0,), _dt(dtype)))

@@ -24,7 +24,7 @@ def _mk(name, fn, n_tensor_args=1):
 
 
 _UNARY = [
-    "abs", "acos", "asin", "atan", "ceil", "cos", "cosh", "digamma", "erf",
+    "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh", "ceil", "cos", "cosh", "digamma", "erf",
     "exp", "expm1", "floor", "frac", "i0", "lgamma", "log", "log10",
     "log1p", "log2", "logit", "nan_to_num", "neg", "reciprocal", "round",
     "rsqrt", "sigmoid", "sign", "sin", "sinc", "sinh", "sqrt", "square",
@@ -128,3 +128,27 @@ def multigammaln_(x, p=1, name=None):
 
 __all__ += ["polygamma_", "multigammaln_", "cast_", "erfinv_", "cumsum_", "cumprod_", "clip_", "scale_",
             "addmm_", "tril_", "triu_", "t_", "where_", "divide_no_nan_"]
+
+
+def lerp_(x, y, weight, name=None):
+    from .math import lerp
+    return x._inplace_assign(lerp(x, y, weight))
+
+
+def index_fill_(x, index, axis, value, name=None):
+    from .manipulation import index_fill
+    return x._inplace_assign(index_fill(x, index, axis, value))
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    from .manipulation import index_put
+    return x._inplace_assign(index_put(x, indices, value, accumulate))
+
+
+def put_along_axis_(x, indices, values, axis, reduce="assign", name=None):
+    from .manipulation import put_along_axis
+    return x._inplace_assign(put_along_axis(x, indices, values, axis,
+                                            reduce))
+
+
+__all__ += ["lerp_", "index_fill_", "index_put_", "put_along_axis_"]

@@ -238,3 +238,68 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
 
 def matrix_exp(x, name=None):
     return apply_op("matrix_exp", jax.scipy.linalg.expm, _t(x))
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by Q from the Householder factors (x, tau) of a QR
+    (reference: tensor/linalg.py ormqr -> LAPACK ?ormqr).  TPU-native:
+    jax.lax.linalg.householder_product materialises Q (one XLA op), then
+    one MXU matmul — the two-step form XLA fuses anyway."""
+    import jax
+
+    from .math import matmul
+
+    def fn(xd, td, yd):
+        import jax.numpy as jnp
+        # householder_product has no JAX differentiation rule; the QR
+        # factors are produced by a non-differentiable factorisation anyway
+        # (matching the reference, which registers no ormqr_grad), so
+        # gradients flow through y only
+        xd = jax.lax.stop_gradient(xd)
+        td = jax.lax.stop_gradient(td)
+        m, n = xd.shape[-2], xd.shape[-1]
+        if m > n:
+            # LAPACK's Q is m x m; pad the reflector block with zero
+            # columns (zero tau = identity reflector) to get the full Q
+            xd = jnp.concatenate(
+                [xd, jnp.zeros(xd.shape[:-1] + (m - n,), xd.dtype)], -1)
+            td = jnp.concatenate(
+                [td, jnp.zeros(td.shape[:-1] + (m - td.shape[-1],),
+                               td.dtype)], -1)
+        q = jax.lax.linalg.householder_product(xd, td)
+        if transpose:
+            q = jnp.swapaxes(q, -1, -2)
+        return jnp.matmul(q, yd) if left else jnp.matmul(yd, q)
+    from ..core.dispatch import apply_op
+    from ..ops._runtime import _t
+    return apply_op("ormqr", fn, _t(x), _t(tau), _t(y))
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (Halko et al.; reference:
+    tensor/linalg.py svd_lowrank).  q: rank of the approximation;
+    niter: power iterations sharpening the spectrum — all dense
+    MXU matmuls plus one tiny exact SVD."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_op
+    from ..ops._runtime import _t
+    from .random import _next_key
+
+    def fn(a, *rest):
+        import jax
+        key = _next_key()  # inside fn: static-program replay stays fresh
+        av = a - rest[0] if rest else a
+        m, n = av.shape[-2], av.shape[-1]
+        r = min(q, m, n)
+        omega = jax.random.normal(key, av.shape[:-2] + (n, r), av.dtype)
+        ys = av @ omega
+        for _ in range(niter):
+            ys = av @ (jnp.swapaxes(av, -1, -2) @ ys)
+        qm, _ = jnp.linalg.qr(ys)
+        b = jnp.swapaxes(qm, -1, -2) @ av
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qm @ u, s, jnp.swapaxes(vh, -1, -2)
+
+    args = [_t(x)] + ([_t(M)] if M is not None else [])
+    return apply_op("svd_lowrank", fn, *args, nout=3)

@@ -299,7 +299,7 @@ def index_sample(x, index):
 
 def index_add(x, index, axis, value, name=None):
     def fn(v, i, val):
-        sl = [slice(None)] * v.ndim
+        sl = [builtins_slice(None)] * v.ndim
         idx = [jnp.broadcast_to(
             jnp.arange(val.shape[d]).reshape([-1 if k == d else 1
                                               for k in range(val.ndim)]),
@@ -323,7 +323,8 @@ def index_put(x, indices, value, accumulate=False, name=None):
 
 def index_fill(x, index, axis, value, name=None):
     def fn(v, i):
-        sl = [slice(None)] * v.ndim
+        # NB: module-level `slice` is the paddle op — use the builtin
+        sl = [builtins_slice(None)] * v.ndim
         sl[axis] = i
         return v.at[tuple(sl)].set(value)
     return apply_op("index_fill", fn, _t(x), index)
@@ -412,9 +413,9 @@ def as_strided(x, shape, stride, offset=0, name=None):
 
 def strided_slice(x, axes, starts, ends, strides, name=None):
     def fn(v):
-        sl = [slice(None)] * v.ndim
+        sl = [builtins_slice(None)] * v.ndim
         for ax, s, e, st in zip(axes, starts, ends, strides):
-            sl[ax] = slice(s, e, st)
+            sl[ax] = builtins_slice(s, e, st)
         return v[tuple(sl)]
     return apply_op("strided_slice", fn, _t(x))
 

@@ -210,3 +210,28 @@ def exponential_(x, lam=1.0, name=None):
     x._data = (jax.random.exponential(_next_key(), x._data.shape) / lam).astype(
         x.dtype)
     return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """In-place Cauchy fill (reference: tensor/random.py cauchy_ ->
+    inverse-CDF over uniform)."""
+    import jax
+
+    u = jax.random.uniform(_next_key(), tuple(x.shape),
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    vals = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+    x._data = vals.astype(x._data.dtype)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """In-place Geometric(probs) fill (number of Bernoulli trials until
+    first success; reference: tensor/random.py geometric_)."""
+    import jax
+
+    p = probs._data if hasattr(probs, "_data") else probs
+    u = jax.random.uniform(_next_key(), tuple(x.shape),
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    vals = jnp.ceil(jnp.log(u) / jnp.log1p(-p))
+    x._data = vals.astype(x._data.dtype)
+    return x

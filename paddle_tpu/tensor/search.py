@@ -135,3 +135,36 @@ def mode(x, axis=-1, keepdim=False, name=None):
 
 
 import jax  # noqa: E402  (used inside topk impl)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling (reference: tensor/search.py top_p_sampling ->
+    top_p_sampling kernel): per row, sample from the smallest
+    probability-sorted prefix whose mass exceeds ps.
+    x: [B, V] probabilities; ps: [B, 1] (or [B]) cumulative thresholds;
+    threshold: tokens with probability below it leave the nucleus.
+    Returns (values [B, 1], ids [B, 1])."""
+    import jax
+    import jax.numpy as jnp
+
+    from .random import _next_key
+
+    def fn(probs, p):
+        key = _next_key()  # inside fn: static-program replay stays fresh
+        p = p.reshape(-1, 1)
+        order = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        # keep the first token whose inclusion crosses p, drop the rest
+        keep = (cum - sorted_p) < p
+        if threshold is not None:
+            keep = keep & (sorted_p >= threshold)
+        filt = jnp.where(keep, sorted_p, 0.0)
+        filt = filt / jnp.maximum(filt.sum(-1, keepdims=True), 1e-9)
+        idx_in_sorted = jax.random.categorical(key, jnp.log(
+            jnp.maximum(filt, 1e-30)), axis=-1)
+        ids = jnp.take_along_axis(order, idx_in_sorted[:, None], axis=-1)
+        vals = jnp.take_along_axis(probs, ids, axis=-1)
+        return vals, ids.astype(jnp.int64)
+
+    return apply_op("top_p_sampling", fn, _t(x), _t(ps), nout=2)

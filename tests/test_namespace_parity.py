@@ -229,3 +229,118 @@ def test_no_infra_helpers_leak_onto_tensor():
     # op methods from every source module still attach
     for good in ("exp", "cdist", "unfold", "sqrt_", "masked_scatter"):
         assert hasattr(Tensor, good), good
+
+
+def test_reference_tensor_method_func_fully_covered():
+    """Every name in the reference's tensor_method_func list (372 methods
+    patched onto Tensor) must exist on our Tensor."""
+    from paddle_tpu.core.tensor import Tensor
+    src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+    block = re.search(r"tensor_method_func = \[(.*?)\]", src, re.S).group(1)
+    names = set(re.findall(r"'([^']+)'", block))
+    assert len(names) > 300
+    missing = sorted(n for n in names if not hasattr(Tensor, n))
+    assert missing == [], f"missing Tensor methods: {missing}"
+
+
+class TestLateMethodAdditions:
+    def test_ormqr_reproduces_full_q(self):
+        import scipy.linalg as sl
+        a = np.random.RandomState(0).rand(6, 4).astype(np.float32)
+        (h, tau), _ = sl.qr(a, mode="raw")
+        got = paddle.ormqr(paddle.to_tensor(np.asarray(h, np.float32)),
+                           paddle.to_tensor(np.asarray(tau, np.float32)),
+                           paddle.to_tensor(np.eye(6, dtype=np.float32)))
+        np.testing.assert_allclose(got.numpy(), sl.qr(a)[0], atol=5e-3)
+
+    def test_svd_lowrank_approximates_top_singular_values(self):
+        a = np.random.RandomState(1).rand(20, 8).astype(np.float32)
+        u, s, v = paddle.svd_lowrank(paddle.to_tensor(a), q=4, niter=3)
+        ref = np.linalg.svd(a, compute_uv=False)[:4]
+        np.testing.assert_allclose(s.numpy(), ref, rtol=0.05)
+        # and the rank-4 reconstruction is close
+        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        assert np.abs(rec - a).max() < np.abs(a).max()
+
+    def test_top_p_sampling_stays_in_nucleus(self):
+        probs = paddle.to_tensor(
+            np.tile(np.array([[0.5, 0.3, 0.15, 0.05]], np.float32),
+                    (8, 1)))
+        vals, ids = paddle.top_p_sampling(
+            probs, paddle.to_tensor(np.full((8, 1), 0.6, np.float32)))
+        assert set(ids.numpy().reshape(-1).tolist()) <= {0, 1}
+
+    def test_cauchy_and_geometric_fills(self):
+        x = paddle.to_tensor(np.zeros(4000, np.float32))
+        x.cauchy_(loc=1.0, scale=0.5)
+        assert abs(float(np.median(x.numpy())) - 1.0) < 0.1
+        g = paddle.to_tensor(np.zeros(4000, np.float32))
+        g.geometric_(0.5)
+        assert (g.numpy() >= 1).all() and 1.8 < g.numpy().mean() < 2.2
+
+    def test_inplace_index_ops(self):
+        x = paddle.to_tensor(np.zeros((3, 2), np.float32))
+        x.index_fill_(paddle.to_tensor(np.array([1], np.int64)), 0, 7.0)
+        np.testing.assert_allclose(x.numpy()[1], 7.0)
+        y = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        y.lerp_(paddle.to_tensor(np.array([3.0, 4.0], np.float32)), 0.5)
+        np.testing.assert_allclose(y.numpy(), [2.0, 3.0])
+
+    def test_attached_late_methods(self):
+        x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+        assert list(x.tril().shape) == [3, 3]
+        assert list(x.diag().shape) == [3]
+        v = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        assert list(v.reverse([0]).numpy()) == [2.0, 1.0]
+        assert paddle.create_tensor("float32").shape == [0]
+
+
+def test_slice_shadow_victims():
+    """index_fill / strided_slice previously crashed because the paddle
+    `slice` op shadows the builtin inside manipulation.py."""
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    out = paddle.index_fill(x, paddle.to_tensor(np.array([0], np.int64)),
+                            0, -1.0)
+    np.testing.assert_allclose(out.numpy()[0], -1.0)
+    np.testing.assert_allclose(out.numpy()[1:], x.numpy()[1:])
+    s = paddle.strided_slice(x, axes=[1], starts=[0], ends=[4], strides=[2])
+    np.testing.assert_allclose(s.numpy(), x.numpy()[:, ::2])
+
+
+def test_builtins_helpers_not_tensor_methods():
+    from paddle_tpu.core.tensor import Tensor
+    assert not hasattr(Tensor, "builtins_slice")
+    assert not hasattr(Tensor, "builtins_sum")
+
+
+def test_ormqr_forward_works_under_autograd():
+    """Q-building has no JAX grad rule; forward must still run in a
+    grad-enabled context (grads flow through y only, like the reference
+    which registers no ormqr_grad)."""
+    import scipy.linalg as sl
+    a = np.random.RandomState(0).rand(5, 3).astype(np.float32)
+    (h, tau), _ = sl.qr(a, mode="raw")
+    x = paddle.to_tensor(np.asarray(h, np.float32))
+    x.stop_gradient = False
+    y = paddle.to_tensor(np.eye(5, dtype=np.float32))
+    y.stop_gradient = False
+    out = paddle.ormqr(x, tau=paddle.to_tensor(np.asarray(tau, np.float32)),
+                       y=y)
+    out.sum().backward()
+    assert y.grad is not None
+
+
+def test_top_p_threshold_excludes_low_prob_tokens():
+    probs = paddle.to_tensor(
+        np.tile(np.array([[0.4, 0.35, 0.2, 0.05]], np.float32), (16, 1)))
+    # ps=0.99 would admit everything; threshold kicks token 3 (p=0.05) out
+    vals, ids = paddle.top_p_sampling(
+        probs, paddle.to_tensor(np.full((16, 1), 0.99, np.float32)),
+        threshold=0.1)
+    assert 3 not in set(ids.numpy().reshape(-1).tolist())
+
+
+def test_geometric_accepts_tensor_probs():
+    g = paddle.to_tensor(np.zeros(100, np.float32))
+    g.geometric_(paddle.to_tensor(np.full(100, 0.5, np.float32)))
+    assert (g.numpy() >= 1).all()
