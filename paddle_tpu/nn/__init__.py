@@ -20,3 +20,8 @@ from .layer.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell,  # noqa: F401
 from .layer.transformer import (MultiHeadAttention, Transformer,  # noqa: F401
                                 TransformerDecoder, TransformerDecoderLayer,
                                 TransformerEncoder, TransformerEncoderLayer)
+from .layer.extended import (  # noqa: F401
+    AdaptiveLogSoftmaxWithLoss, BeamSearchDecoder, FractionalMaxPool2D,
+    FractionalMaxPool3D, HSigmoidLoss, LayerDict, MaxUnPool1D, MaxUnPool2D,
+    MaxUnPool3D, MultiMarginLoss, RNNTLoss, Softmax2D,
+    TripletMarginWithDistanceLoss, Unflatten, dynamic_decode)

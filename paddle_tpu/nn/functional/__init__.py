@@ -10,3 +10,12 @@ from .loss import *  # noqa: F401,F403
 from .norm import (batch_norm, group_norm, instance_norm, layer_norm,  # noqa: F401
                    local_response_norm, rms_norm, spectral_norm)
 from .pooling import *  # noqa: F401,F403
+from .extended import (  # noqa: F401
+    adaptive_log_softmax_with_loss, affine_grid, class_center_sample, elu_,
+    flash_attention_with_sparse_mask, flash_attn_qkvpacked,
+    flash_attn_varlen_qkvpacked, fractional_max_pool2d,
+    fractional_max_pool3d, gather_tree, grid_sample, hardtanh_,
+    hsigmoid_loss, leaky_relu_, margin_cross_entropy, max_unpool1d,
+    max_unpool2d, max_unpool3d, multi_margin_loss, pairwise_distance,
+    relu_, rnnt_loss, sequence_mask, tanh_, temporal_shift,
+    thresholded_relu_)

@@ -1,0 +1,628 @@
+"""nn.functional breadth: the reference API surface not covered by the core
+modules (reference: python/paddle/nn/functional/ — pooling.py max_unpool*,
+vision.py affine_grid/grid_sample/temporal_shift, common.py
+class_center_sample, loss.py multi_margin/hsigmoid, extension.py
+sequence_mask/gather_tree, activation.py inplace twins)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from ...ops._runtime import _t
+from . import activation as _act
+
+
+# -- inplace activation twins ------------------------------------------------
+def elu_(x, alpha=1.0, name=None):
+    return x._inplace_assign(_act.elu(x, alpha))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    return x._inplace_assign(_act.hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    return x._inplace_assign(_act.leaky_relu(x, negative_slope))
+
+
+def tanh_(x, name=None):
+    return x._inplace_assign(_act.tanh(x))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    return x._inplace_assign(_act.thresholded_relu(x, threshold, value))
+
+
+def relu_(x, name=None):
+    return x._inplace_assign(_act.relu(x))
+
+
+# -- sequence / beam utilities ----------------------------------------------
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths -> [.., maxlen] step-valid mask (reference:
+    nn/functional/extension.py sequence_mask)."""
+    from ...core import dtype as dtypes
+    lens = _t(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(lens.numpy()).max())
+    dt = dtypes.convert_dtype(dtype)
+    return apply_op(
+        "sequence_mask",
+        lambda v: (jnp.arange(maxlen) < v[..., None]).astype(dt), lens)
+
+
+def gather_tree(ids, parents, name=None):
+    """Reconstruct full beam paths by walking parent pointers backwards
+    (reference: gather_tree op; here one lax.scan over time).
+    ids/parents: [T, B, beam] int."""
+    def fn(idv, pv):
+        T = idv.shape[0]
+
+        def step(next_beam, t):
+            tok = jnp.take_along_axis(idv[t], next_beam, axis=-1)
+            par = jnp.take_along_axis(pv[t], next_beam, axis=-1)
+            return par, tok
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[-1]), idv.shape[1:])
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+    return apply_op("gather_tree", fn, _t(ids), _t(parents))
+
+
+# -- vision -------------------------------------------------------------------
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2] (reference:
+    nn/functional/vision.py affine_grid)."""
+    N, C, H, W = [int(s) for s in out_shape]
+
+    def fn(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, W)
+            ys = jnp.linspace(-1.0, 1.0, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+            ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+        gx, gy = jnp.meshgrid(xs, ys)               # [H, W]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)   # [H, W, 3]
+        return jnp.einsum("hwk,nck->nhwc", base, th)
+    return apply_op("affine_grid", fn, _t(theta))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x [N,C,H,W] at normalized grid [N,Ho,Wo,2] (reference:
+    nn/functional/vision.py grid_sample -> grid_sample kernel).  Gather +
+    lerp — XLA fuses it into the surrounding program."""
+    def fn(v, g):
+        N, C, H, W = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+        if mode == "nearest":
+            ix = jnp.round(fx).astype(jnp.int32)
+            iy = jnp.round(fy).astype(jnp.int32)
+            inb = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+            ix = jnp.clip(ix, 0, W - 1)
+            iy = jnp.clip(iy, 0, H - 1)
+            out = v[jnp.arange(N)[:, None, None], :, iy, ix]
+            out = jnp.moveaxis(out, -1, 1)
+            if padding_mode == "zeros":
+                out = out * inb[:, None].astype(v.dtype)
+            return out
+
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        wx = (fx - x0)[:, None]                      # [N,1,Ho,Wo]
+        wy = (fy - y0)[:, None]
+
+        def tap(ix, iy):
+            inb = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+            cx = jnp.clip(ix, 0, W - 1)
+            cy = jnp.clip(iy, 0, H - 1)
+            val = v[jnp.arange(N)[:, None, None], :, cy, cx]  # [N,Ho,Wo,C]
+            val = jnp.moveaxis(val, -1, 1)                    # [N,C,Ho,Wo]
+            if padding_mode == "zeros":
+                val = val * inb[:, None].astype(v.dtype)
+            return val
+
+        return (tap(x0, y0) * (1 - wx) * (1 - wy)
+                + tap(x0 + 1, y0) * wx * (1 - wy)
+                + tap(x0, y0 + 1) * (1 - wx) * wy
+                + tap(x0 + 1, y0 + 1) * wx * wy)
+    return apply_op("grid_sample", fn, _t(x), _t(grid))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM channel shift across time (reference:
+    nn/functional/extension.py temporal_shift)."""
+    def fn(v):
+        if data_format == "NHWC":
+            v = jnp.moveaxis(v, -1, 1)
+        NT, C, H, W = v.shape
+        T = seg_num
+        v = v.reshape(NT // T, T, C, H, W)
+        fold = int(C * shift_ratio)
+        back = jnp.concatenate([v[:, 1:, :fold],
+                                jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                               v[:, :-1, fold:2 * fold]], axis=1)
+        out = jnp.concatenate([back, fwd, v[:, :, 2 * fold:]], axis=2)
+        out = out.reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply_op("temporal_shift", fn, _t(x))
+
+
+# -- unpooling ----------------------------------------------------------------
+def _max_unpool(x, indices, spatial, kernel_size, stride, padding,
+                output_size, data_format, op_name):
+    from .pooling import _ntuple
+    ks = _ntuple(kernel_size, spatial)
+    st = _ntuple(stride if stride is not None else kernel_size, spatial)
+    pd = _ntuple(padding, spatial)
+    xin = _t(x)
+    in_sp = [int(s) for s in xin.shape[2:]]
+    if output_size is None:
+        out_sp = [(in_sp[i] - 1) * st[i] - 2 * pd[i] + ks[i]
+                  for i in range(spatial)]
+    else:
+        out_sp = [int(s) for s in list(output_size)[-spatial:]]
+    P = int(np.prod(out_sp))
+
+    def fn(v, idx):
+        N, C = v.shape[0], v.shape[1]
+        flat_v = v.reshape(N, C, -1)
+        flat_i = idx.reshape(N, C, -1)
+        out = jnp.zeros((N, C, P), v.dtype)
+        n_ix = jnp.arange(N)[:, None, None]
+        c_ix = jnp.arange(C)[None, :, None]
+        out = out.at[n_ix, c_ix, flat_i].set(flat_v)
+        return out.reshape((N, C) + tuple(out_sp))
+    return apply_op(op_name, fn, xin, _t(indices))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Inverse of max_pool1d via the pool mask (reference:
+    phi/kernels/.../unpool_kernel)."""
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format, "max_unpool3d")
+
+
+# -- losses -------------------------------------------------------------------
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """Row-wise p-distance (reference: nn/functional/distance.py)."""
+    return apply_op(
+        "pairwise_distance",
+        lambda a, b: jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a - b + epsilon), p), axis=-1),
+            1.0 / p)[..., None] if keepdim else jnp.power(
+                jnp.sum(jnp.power(jnp.abs(a - b + epsilon), p), axis=-1),
+                1.0 / p),
+        _t(x), _t(y))
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin loss (reference: nn/functional/loss.py
+    multi_margin_loss)."""
+    def fn(logits, lbl, *w):
+        N, C = logits.shape
+        correct = jnp.take_along_axis(logits, lbl[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - correct + logits) ** p
+        if w:
+            m = m * jnp.take(w[0], lbl)[:, None]
+        m = m * (1 - jax.nn.one_hot(lbl, C, dtype=logits.dtype))
+        per = m.sum(axis=1) / C
+        if reduction == "mean":
+            return per.mean()
+        if reduction == "sum":
+            return per.sum()
+        return per
+    args = [_t(input), _t(label)] + ([_t(weight)]
+                                     if weight is not None else [])
+    return apply_op("multi_margin_loss", fn, *args)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: nn/functional/loss.py hsigmoid_loss -> hsigmoid kernel;
+    custom path_table/path_code trees are rejected explicitly)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid_loss: custom trees (path_table/path_code) are not "
+            "supported; the default complete-binary-tree layout is")
+    # default tree: num_classes leaves, internal nodes = num_classes - 1,
+    # leaf k's path derived from the heap layout of node (k + n_internal)
+    n_internal = num_classes - 1
+    codes, tables, lens = [], [], []
+    for k in range(num_classes):
+        node = k + n_internal
+        path, code = [], []
+        while node > 0:
+            parent = (node - 1) // 2
+            code.append(node == 2 * parent + 2)  # right child -> 1
+            path.append(parent)
+            node = parent
+        tables.append(path[::-1])
+        codes.append(code[::-1])
+        lens.append(len(path))
+    L = max(lens)
+    tbl = np.zeros((num_classes, L), np.int32)
+    cod = np.zeros((num_classes, L), np.float32)
+    msk = np.zeros((num_classes, L), np.float32)
+    for k in range(num_classes):
+        tbl[k, :lens[k]] = tables[k]
+        cod[k, :lens[k]] = codes[k]
+        msk[k, :lens[k]] = 1.0
+    tbl_j, cod_j, msk_j = map(jnp.asarray, (tbl, cod, msk))
+
+    def fn(xv, lbl, w, *b):
+        pt = tbl_j[lbl]                 # [N, L] node ids
+        pc = cod_j[lbl]                 # [N, L] 0/1 directions
+        pm = msk_j[lbl]                 # [N, L] valid
+        wn = w[pt]                      # [N, L, D]
+        logits = jnp.einsum("nld,nd->nl", wn, xv)
+        if b:
+            logits = logits + b[0][pt]
+        # BCE with target = code
+        loss = (jnp.maximum(logits, 0) - logits * pc
+                + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        return (loss * pm).sum(axis=1, keepdims=True)
+    args = [_t(input), _t(label), _t(weight)] + (
+        [_t(bias)] if bias is not None else [])
+    return apply_op("hsigmoid_loss", fn, *args)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers + remap labels (PartialFC; reference:
+    nn/functional/common.py class_center_sample).  Host-side: the sampled
+    id set is data-dependent."""
+    lbl = np.asarray(_t(label).numpy()).astype(np.int64)
+    pos = np.unique(lbl)
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    n_extra = max(0, min(num_samples, num_classes) - pos.size)
+    rng = np.random.RandomState(np.int64(lbl.sum()) % (2**31))
+    extra = rng.choice(rest, size=n_extra, replace=False) \
+        if n_extra else np.zeros(0, np.int64)
+    sampled = np.concatenate([pos, np.sort(extra)])
+    remap = {int(c): i for i, c in enumerate(sampled)}
+    new_lbl = np.asarray([remap[int(v)] for v in lbl], np.int64)
+    return (Tensor._wrap(jnp.asarray(new_lbl)),
+            Tensor._wrap(jnp.asarray(sampled)))
+
+
+# -- packed flash-attention wrappers -----------------------------------------
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         name=None):
+    """qkv [B, S, 3, H, D] packed form (reference:
+    nn/functional/flash_attention.py flash_attn_qkvpacked)."""
+    from .flash_attention import flash_attention
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, name=None):
+    """Varlen packed form over the unpadded path (reference:
+    flash_attn_unpadded)."""
+    from .flash_attention import flash_attn_unpadded
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(int(q.shape[-1])))
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale, dropout,
+                               causal, return_softmax)
+
+
+def flash_attention_with_sparse_mask(query, key, value,
+                                     attn_mask_start_row_indices,
+                                     attn_mask_start_row=0, dropout_p=0.0,
+                                     is_causal=True, name=None):
+    """Row-sparse causal attention: row i attends keys
+    [start_row_indices[i], i] (reference:
+    flash_attention_with_sparse_mask).  Realised as a dense additive mask
+    into scaled_dot_product_attention — same numerics, XLA-fused."""
+    def fn(qd, kd, vd, rows):
+        B, S, H = qd.shape[0], qd.shape[1], qd.shape[2]
+        i = jnp.arange(S)[:, None]
+        j = jnp.arange(S)[None, :]
+        causal = j <= i if is_causal else jnp.ones((S, S), bool)
+        # start rows: [B, H, S] (reference shape) or [S] broadcast
+        start = jnp.broadcast_to(rows.reshape(rows.shape[-3:]
+                                              if rows.ndim >= 3
+                                              else (1, 1, S)), (B, H, S))
+        # query row i attends keys j in [start[b, h, i], i]
+        allowed = causal[None, None] & (
+            jnp.arange(S)[None, None, None, :] >= start[..., None])
+        logits_mask = jnp.where(allowed, 0.0, -jnp.inf)  # [B, H, S, S]
+        d = qd.shape[-1]
+        att = jnp.einsum("bshd,bthd->bhst", qd.astype(jnp.float32),
+                         kd.astype(jnp.float32)) / jnp.sqrt(float(d))
+        att = att + logits_mask
+        p = jax.nn.softmax(att, axis=-1)
+        return jnp.einsum("bhst,bthd->bshd", p.astype(vd.dtype), vd)
+    return apply_op("flash_attention_with_sparse_mask", fn, _t(query),
+                    _t(key), _t(value), _t(attn_mask_start_row_indices))
+
+
+# -- fractional pooling -------------------------------------------------------
+def _fractional_edges(in_size, out_size, u):
+    """Graham's pseudo-random pooling boundaries: ceil(alpha*(i+u)) with
+    alpha = in/out; strictly increasing, cover [0, in]."""
+    alpha = in_size / out_size
+    idx = np.arange(out_size + 1, dtype=np.float64)
+    edges = np.ceil(alpha * (idx + u)).astype(np.int64) - int(
+        np.ceil(alpha * u))
+    edges = np.clip(edges, 0, in_size)
+    edges[0], edges[-1] = 0, in_size
+    return edges
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (Graham 2014; reference:
+    nn/functional/pooling.py fractional_max_pool2d).  Variable-width bins
+    realised as a scatter-max of each input pixel into its bin."""
+    if kernel_size is not None:
+        raise NotImplementedError(
+            "fractional_max_pool2d: kernel_size (overlapping windows) is "
+            "not supported — the default disjoint-bin mode "
+            "(kernel_size=None) is")
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    xin = _t(x)
+    N, C, H, W = [int(s) for s in xin.shape]
+    u = float(random_u) if random_u is not None else float(
+        np.random.RandomState(0).uniform(0.05, 0.95))
+    eh = _fractional_edges(H, oh, u)
+    ew = _fractional_edges(W, ow, u)
+    row_bin = np.searchsorted(eh[1:], np.arange(H), side="right")
+    col_bin = np.searchsorted(ew[1:], np.arange(W), side="right")
+    rb, cb = jnp.asarray(row_bin), jnp.asarray(col_bin)
+
+    def fn(v):
+        out = jnp.full((N, C, oh, ow), -jnp.inf, v.dtype)
+        n_ix = jnp.arange(N)[:, None, None, None]
+        c_ix = jnp.arange(C)[None, :, None, None]
+        r_ix = jnp.broadcast_to(rb[None, None, :, None], v.shape)
+        w_ix = jnp.broadcast_to(cb[None, None, None, :], v.shape)
+        return out.at[n_ix, c_ix, r_ix, w_ix].max(v)
+    out = apply_op("fractional_max_pool2d", fn, xin)
+    if not return_mask:
+        return out
+    # mask: flat input index of each bin's max (host-side; the mask is an
+    # inference artifact consumed by unpool, not a grad path)
+    vnp = np.asarray(xin.numpy())
+    mask = np.zeros((N, C, oh, ow), np.int64)
+    for i in range(oh):
+        for j in range(ow):
+            blk = vnp[:, :, eh[i]:eh[i + 1], ew[j]:ew[j + 1]]
+            bh = eh[i + 1] - eh[i]
+            bw = ew[j + 1] - ew[j]
+            am = blk.reshape(N, C, -1).argmax(-1)
+            r = am // bw + eh[i]
+            c = am % bw + ew[j]
+            mask[:, :, i, j] = r * W + c
+    return out, Tensor._wrap(jnp.asarray(mask))
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """3-D variant: same boundary scheme per spatial dim."""
+    if kernel_size is not None:
+        raise NotImplementedError(
+            "fractional_max_pool3d: kernel_size (overlapping windows) is "
+            "not supported — the default disjoint-bin mode is")
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    od, oh, ow = output_size
+    xin = _t(x)
+    N, C, D, H, W = [int(s) for s in xin.shape]
+    u = float(random_u) if random_u is not None else float(
+        np.random.RandomState(0).uniform(0.05, 0.95))
+    ed = _fractional_edges(D, od, u)
+    eh = _fractional_edges(H, oh, u)
+    ew = _fractional_edges(W, ow, u)
+    db = jnp.asarray(np.searchsorted(ed[1:], np.arange(D), side="right"))
+    rb = jnp.asarray(np.searchsorted(eh[1:], np.arange(H), side="right"))
+    cb = jnp.asarray(np.searchsorted(ew[1:], np.arange(W), side="right"))
+
+    def fn(v):
+        out = jnp.full((N, C, od, oh, ow), -jnp.inf, v.dtype)
+        n_ix = jnp.arange(N)[:, None, None, None, None]
+        c_ix = jnp.arange(C)[None, :, None, None, None]
+        d_ix = jnp.broadcast_to(db[None, None, :, None, None], v.shape)
+        r_ix = jnp.broadcast_to(rb[None, None, None, :, None], v.shape)
+        w_ix = jnp.broadcast_to(cb[None, None, None, None, :], v.shape)
+        return out.at[n_ix, c_ix, d_ix, r_ix, w_ix].max(v)
+    out = apply_op("fractional_max_pool3d", fn, xin)
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d: return_mask is 2d-only here")
+    return out
+
+
+# -- RNN-T loss ---------------------------------------------------------------
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN transducer loss (Graves 2012; reference: nn/functional/loss.py
+    rnnt_loss -> warprnnt kernel).
+
+    TPU-native: the (T, U) forward-variable DP runs as a lax.scan over T
+    with a lax.scan over U inside (log-semiring first-order recurrences);
+    everything is batched and traceable, no warp-level kernel needed.
+    input: [B, T, U+1, V] logits; label: [B, U]."""
+    def fn(logits, lbl, t_len, u_len):
+        B, T, U1, V = logits.shape
+        U = U1 - 1
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        blank_lp = logp[..., blank]                        # [B, T, U+1]
+        lbl_lp = jnp.take_along_axis(
+            logp[:, :, :U, :], jnp.broadcast_to(
+                lbl[:, None, :, None], (B, T, U, 1)).astype(jnp.int32),
+            axis=-1)[..., 0]                               # [B, T, U]
+        if fastemit_lambda:
+            # FastEmit (Yu et al. 2021): scale label-emission GRADIENTS by
+            # (1+lambda) while leaving the loss value unchanged — exactly
+            # what warprnnt's fastemit_lambda does.  value(x)=x,
+            # grad(x)=(1+lambda)*dx:
+            lbl_lp = ((1.0 + fastemit_lambda) * lbl_lp
+                      - fastemit_lambda * jax.lax.stop_gradient(lbl_lp))
+        NEG = jnp.float32(-1e30)
+
+        def t_step(alpha_prev, t):
+            # emit path into row t: alpha_prev[u] + blank[t-1, u]
+            from_blank = jnp.where(
+                t > 0, alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :],
+                jnp.where(jnp.arange(U1)[None, :] == 0, 0.0, NEG))
+
+            # label path within row t: alpha[t, u-1] + label[t, u-1]
+            def u_step(carry, u):
+                lab = jnp.where(
+                    u > 0, lbl_lp[:, t, jnp.maximum(u - 1, 0)], NEG)
+                val = jnp.logaddexp(from_blank[:, u],
+                                    jnp.where(u > 0, carry + lab, NEG))
+                val = jnp.where(t == 0,
+                                jnp.where(u > 0, carry + lab, 0.0), val)
+                return val, val
+
+            _, cols = jax.lax.scan(u_step, jnp.full((B,), NEG),
+                                   jnp.arange(U1))
+            return jnp.transpose(cols), jnp.transpose(cols)
+
+        _, alphas = jax.lax.scan(t_step, jnp.full((B, U1), NEG),
+                                 jnp.arange(T))             # [T, B, U+1]
+        alphas = jnp.transpose(alphas, (1, 0, 2))           # [B, T, U+1]
+        t_last = (t_len - 1).astype(jnp.int32)
+        u_last = u_len.astype(jnp.int32)
+        a_final = jnp.take_along_axis(
+            jnp.take_along_axis(alphas, t_last[:, None, None],
+                                axis=1)[:, 0, :],
+            u_last[:, None], axis=1)[:, 0]
+        final_blank = jnp.take_along_axis(
+            jnp.take_along_axis(blank_lp, t_last[:, None, None],
+                                axis=1)[:, 0, :],
+            u_last[:, None], axis=1)[:, 0]
+        nll = -(a_final + final_blank)
+        if reduction == "mean":
+            return nll.mean()
+        if reduction == "sum":
+            return nll.sum()
+        return nll
+    return apply_op("rnnt_loss", fn, _t(input), _t(label),
+                    _t(input_lengths), _t(label_lengths))
+
+
+# -- adaptive softmax ---------------------------------------------------------
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (Grave et al.; reference: nn/functional/loss.py
+    adaptive_log_softmax_with_loss).  Head covers [0, cutoff0) plus one
+    logit per tail cluster; cluster i projects down then scores its slice.
+    Returns (per-sample log-prob of the target, mean negative loss)."""
+    cutoffs = list(cutoffs)
+    n_clusters = len(cutoffs)
+    head_size = cutoffs[0] + n_clusters
+
+    def fn(xv, lbl, hw, *rest):
+        it = list(rest)
+        hb = it.pop(0) if head_bias is not None else None
+        tails = []
+        while it:
+            tails.append((it.pop(0), it.pop(0)))  # (proj, cls_w) per cluster
+        head = xv @ hw
+        if hb is not None:
+            head = head + hb
+        head_lp = jax.nn.log_softmax(head, axis=-1)
+        out = jnp.zeros(lbl.shape, head.dtype)
+        in_head = lbl < cutoffs[0]
+        out = jnp.where(in_head,
+                        jnp.take_along_axis(
+                            head_lp, jnp.clip(lbl, 0, head_size - 1)[:, None],
+                            axis=1)[:, 0],
+                        out)
+        # tail cluster i covers [cutoffs[i-1], cutoffs[i]) with
+        # cutoffs[-1] meaning cutoffs[0] (the head boundary)
+        lo = cutoffs[0]
+        for ci, (proj, cls_w) in enumerate(tails):
+            hi = cutoffs[ci + 1] if ci + 1 < len(cutoffs) else None
+            mask = (lbl >= lo) & ((lbl < hi) if hi is not None
+                                  else jnp.ones_like(lbl, bool))
+            tail_lp = jax.nn.log_softmax((xv @ proj) @ cls_w, axis=-1)
+            rel = jnp.clip(lbl - lo, 0, tail_lp.shape[1] - 1)
+            lp = (head_lp[:, cutoffs[0] + ci]
+                  + jnp.take_along_axis(tail_lp, rel[:, None], axis=1)[:, 0])
+            out = jnp.where(mask, lp, out)
+            lo = hi if hi is not None else lo
+        return out, -out.mean()
+
+    args = [_t(input), _t(label), _t(head_weight)]
+    if head_bias is not None:
+        args.append(_t(head_bias))
+    for pair in tail_weights:
+        args.extend([_t(pair[0]), _t(pair[1])])
+    return apply_op("adaptive_log_softmax_with_loss", fn, *args, nout=2)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-family margin softmax (reference: nn/functional/loss.py
+    margin_cross_entropy -> margin_cross_entropy kernel): target logit
+    cos(theta) becomes cos(m1*theta + m2) - m3, everything scaled by s."""
+    def fn(lg, lbl):
+        N, C = lg.shape
+        cos_t = jnp.take_along_axis(lg, lbl[:, None], axis=1)[:, 0]
+        theta = jnp.arccos(jnp.clip(cos_t, -1.0 + 1e-7, 1.0 - 1e-7))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        oh = jax.nn.one_hot(lbl, C, dtype=lg.dtype)
+        adj = lg * (1 - oh) + target[:, None] * oh
+        adj = adj * scale
+        lp = jax.nn.log_softmax(adj, axis=-1)
+        nll = -jnp.take_along_axis(lp, lbl[:, None], axis=1)[:, 0]
+        if reduction == "mean":
+            loss = nll.mean()
+        elif reduction == "sum":
+            loss = nll.sum()
+        else:
+            loss = nll[:, None]
+        if return_softmax:
+            return loss, jnp.exp(lp)
+        return loss
+    return apply_op("margin_cross_entropy", fn, _t(logits), _t(label),
+                    nout=2 if return_softmax else 1)

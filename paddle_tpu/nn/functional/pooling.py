@@ -82,7 +82,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
     out = _pool(x, "max_pool2d", "max", -np.inf, kernel_size, stride, padding,
                 2, data_format, ceil_mode)
     if return_mask:
-        return out, _pool_mask(x, out, kernel_size, stride, padding, 2)
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 2,
+                               data_format)
     return out
 
 
@@ -91,18 +92,63 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
     out = _pool(x, "max_pool3d", "max", -np.inf, kernel_size, stride, padding,
                 3, data_format, ceil_mode)
     if return_mask:
-        return out, _pool_mask(x, out, kernel_size, stride, padding, 3)
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 3,
+                               data_format)
     return out
 
 
-def _pool_mask(x, out, kernel_size, stride, padding, spatial):
-    # indices of max within each window (flattened spatial index)
+def _pool_mask(x, out, kernel_size, stride, padding, spatial,
+               data_format="NCHW"):
+    """Flattened-spatial input index of each window's max (the reference's
+    max_pool mask output, consumed by max_unpool*d).  Gather every window's
+    candidates, argmax, convert the winner's per-dim coords to a flat
+    index."""
+    import functools
+    import operator
+
     ks = _ntuple(kernel_size, spatial)
     st = _ntuple(stride if stride is not None else kernel_size, spatial)
+    if isinstance(padding, str):
+        raise ValueError("return_mask with string padding is unsupported")
+    pads = _padding(padding, spatial)   # [(before, after)] per dim
+    pd = [p[0] for p in pads]           # window math uses the leading pad
     d = _t(x)._data
-    # brute force via unfold-style comparison
-    idx = jnp.zeros(out._data.shape, dtype=jnp.int64)
-    return Tensor._wrap(idx)
+    od = out._data
+    if not data_format.startswith("NC"):   # NHWC/NDHWC -> NC-first
+        d = jnp.moveaxis(d, -1, 1)
+        od = jnp.moveaxis(od, -1, 1)
+    sp = d.shape[2:]
+    out_sp = od.shape[2:]
+
+    grids = []
+    for i in range(spatial):
+        g = (jnp.arange(out_sp[i])[:, None] * st[i] - pd[i]
+             + jnp.arange(ks[i])[None, :])              # [O_i, k_i]
+        shape = [1] * (2 * spatial)
+        shape[i], shape[spatial + i] = g.shape
+        grids.append(g.reshape(shape))
+    full = tuple(out_sp) + tuple(ks)
+    bc = [jnp.broadcast_to(g, full) for g in grids]
+    valid = functools.reduce(operator.and_,
+                             [(b >= 0) & (b < sp[i])
+                              for i, b in enumerate(bc)])
+    clipped = [jnp.clip(b, 0, sp[i] - 1) for i, b in enumerate(bc)]
+    vals = d[(slice(None), slice(None)) + tuple(clipped)]  # [N,C,*O,*k]
+    vals = jnp.where(valid, vals, -jnp.inf)
+    k_total = int(np.prod(ks))
+    win = jnp.argmax(vals.reshape(vals.shape[:2 + spatial] + (k_total,)),
+                     axis=-1)                              # [N, C, *O]
+    mult = 1
+    acc = jnp.zeros(tuple(out_sp) + (k_total,), jnp.int64)
+    for i in reversed(range(spatial)):
+        acc = acc + clipped[i].reshape(tuple(out_sp) + (-1,)) * mult
+        mult *= sp[i]
+    picked = jnp.take_along_axis(
+        jnp.broadcast_to(acc, win.shape + (k_total,)), win[..., None],
+        axis=-1)[..., 0]
+    if not data_format.startswith("NC"):
+        picked = jnp.moveaxis(picked, 1, -1)
+    return Tensor._wrap(picked.astype(jnp.int64))
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):

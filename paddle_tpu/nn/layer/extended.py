@@ -1,0 +1,359 @@
+"""Layer breadth (reference: python/paddle/nn/layer/ — the classes wrapping
+functional/extended.py plus containers and seq2seq decoding)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from .. import functional as F
+from ..functional.init_utils import param_attr_init
+from .layers import Layer
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW (reference:
+    nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(f"Softmax2D expects 3D/4D input, got {x.ndim}D")
+        return F.softmax(x, axis=-3)
+
+
+class Unflatten(Layer):
+    """Split one dim into a shape (reference: nn/layer/common.py
+    Unflatten)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        new = list(x.shape)
+        ax = self.axis % len(new)
+        new[ax:ax + 1] = self.shape
+        return paddle.reshape(x, new)
+
+
+class LayerDict(Layer):
+    """Dict container of sublayers (reference: nn/layer/container.py
+    LayerDict)."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        self._dict_keys = []
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return getattr(self, key)
+
+    def __setitem__(self, key, layer):
+        if key not in self._dict_keys:
+            self._dict_keys.append(key)
+        setattr(self, key, layer)
+
+    def __delitem__(self, key):
+        self._dict_keys.remove(key)
+        delattr(self, key)
+
+    def __len__(self):
+        return len(self._dict_keys)
+
+    def __iter__(self):
+        return iter(self._dict_keys)
+
+    def __contains__(self, key):
+        return key in self._dict_keys
+
+    def keys(self):
+        return list(self._dict_keys)
+
+    def values(self):
+        return [self[k] for k in self._dict_keys]
+
+    def items(self):
+        return [(k, self[k]) for k in self._dict_keys]
+
+    def update(self, sublayers):
+        pairs = sublayers.items() if isinstance(sublayers, dict) \
+            else sublayers
+        for k, v in pairs:
+            self[k] = v
+
+
+class _UnpoolBase(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+
+class MaxUnPool1D(_UnpoolBase):
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format or "NCL",
+                              self.output_size)
+
+
+class MaxUnPool2D(_UnpoolBase):
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format or "NCHW",
+                              self.output_size)
+
+
+class MaxUnPool3D(_UnpoolBase):
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format or "NCDHW",
+                              self.output_size)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size,
+                                       self.kernel_size, self.random_u,
+                                       self.return_mask)
+
+
+class FractionalMaxPool3D(FractionalMaxPool2D):
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size,
+                                       self.kernel_size, self.random_u,
+                                       self.return_mask)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """Triplet loss with a pluggable distance callable (reference:
+    nn/layer/loss.py TripletMarginWithDistanceLoss)."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.dist = distance_function or (
+            lambda a, b: F.pairwise_distance(a, b))
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, anchor, positive, negative):
+        import paddle_tpu as paddle
+        d_pos = self.dist(anchor, positive)
+        d_neg = self.dist(anchor, negative)
+        if self.swap:
+            d_neg = paddle.minimum(d_neg, self.dist(positive, negative))
+        loss = paddle.clip(d_pos - d_neg + self.margin, min=0.0)
+        if self.reduction == "mean":
+            return loss.mean()
+        if self.reduction == "sum":
+            return loss.sum()
+        return loss
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classifier head (reference: nn/layer/loss.py
+    HSigmoidLoss — holds the internal-node weight table)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError("HSigmoidLoss: custom trees are not "
+                                      "supported (default tree only)")
+        self.num_classes = num_classes
+        self.weight = param_attr_init((num_classes - 1, feature_size),
+                                      self._dtype, weight_attr, False, None)
+        self.bias = (param_attr_init((num_classes - 1,), self._dtype,
+                                     bias_attr, True, None)
+                     if bias_attr is not False else None)
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax head (reference: nn/layer/loss.py
+    AdaptiveLogSoftmaxWithLoss): head covers the frequent classes + one
+    logit per tail cluster; cluster i projects to in_features//div_value^i
+    then scores its class slice."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if not cutoffs or cutoffs != sorted(set(cutoffs)) \
+                or cutoffs[-1] > n_classes:
+            raise ValueError(f"bad cutoffs {cutoffs} for {n_classes}")
+        if cutoffs[-1] != n_classes:
+            cutoffs = cutoffs + [n_classes]
+        self.cutoffs = cutoffs
+        self.n_clusters = len(cutoffs) - 1
+        head_size = cutoffs[0] + self.n_clusters
+        self.head_weight = param_attr_init((in_features, head_size),
+                                           self._dtype, None, False, None)
+        self.head_bias = (param_attr_init((head_size,), self._dtype, None,
+                                          True, None) if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = cutoffs[i + 1] - cutoffs[i]
+            proj = param_attr_init((in_features, hsz), self._dtype, None,
+                                   False, None)
+            cls_w = param_attr_init((hsz, osz), self._dtype, None, False,
+                                    None)
+            setattr(self, f"tail_proj_{i}", proj)
+            setattr(self, f"tail_cls_{i}", cls_w)
+            self.tail_weights.append((proj, cls_w))
+
+    def forward(self, input, label):
+        out, loss = F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs[:-1] if len(self.cutoffs) > 1 else self.cutoffs,
+            self.head_bias)
+        return out, loss
+
+
+class BeamSearchDecoder:
+    """Beam-search decoder over an RNN cell (reference:
+    nn/layer/rnn.py BeamSearchDecoder).  Host-driven expand/top-k per step
+    (the reference's dynamic_decode loop is host-driven too); finalize
+    walks parents via gather_tree."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        import paddle_tpu as paddle
+        states = initial_cell_states
+        B = int(jnp.asarray(states[0]._data).shape[0]) \
+            if isinstance(states, (list, tuple)) else \
+            int(states._data.shape[0])
+        K = self.beam_size
+        tok = paddle.to_tensor(np.full((B, K), self.start_token, np.int64))
+        # beam 0 live, others -inf so step one expands a single beam
+        lp = paddle.to_tensor(
+            np.tile(np.array([[0.0] + [-1e9] * (K - 1)], np.float32),
+                    (B, 1)))
+        tile = (lambda s: paddle.to_tensor(np.repeat(
+            np.asarray(s.numpy()), K, axis=0)))
+        states = [tile(s) for s in states] \
+            if isinstance(states, (list, tuple)) else tile(states)
+        fin = paddle.to_tensor(np.zeros((B, K), bool))
+        return tok, lp, states, fin
+
+    def step(self, tok, log_probs, states, finished):
+        import paddle_tpu as paddle
+        B, K = tok.shape
+        inp = self.embedding_fn(tok.reshape([B * K])) \
+            if self.embedding_fn else tok.reshape([B * K, 1]).astype(
+                "float32")
+        out, new_states = self.cell(inp, states)
+        logits = self.output_fn(out) if self.output_fn else out
+        V = logits.shape[-1]
+        step_lp = np.array(
+            paddle.nn.functional.log_softmax(logits).numpy(),
+            copy=True).reshape(B, K, V)
+        # finished beams only extend with end_token at zero cost
+        fin = np.asarray(finished.numpy())
+        for b in range(B):
+            for k in range(K):
+                if fin[b, k]:
+                    step_lp[b, k, :] = -1e9
+                    step_lp[b, k, self.end_token] = 0.0
+        total = np.asarray(log_probs.numpy())[:, :, None] + step_lp
+        flat = total.reshape(B, K * V)
+        top = np.argsort(-flat, axis=1)[:, :K]
+        parent = top // V
+        token = top % V
+        new_lp = np.take_along_axis(flat, top, axis=1)
+        new_fin = np.take_along_axis(fin, parent, axis=1) | (
+            token == self.end_token)
+
+        def pick(s):
+            arr = np.asarray(s.numpy()).reshape((B, K) + s.numpy().shape[1:])
+            out = np.stack([arr[b, parent[b]] for b in range(B)])
+            return paddle.to_tensor(out.reshape((B * K,) + out.shape[2:]))
+        new_states = [pick(s) for s in new_states] \
+            if isinstance(new_states, (list, tuple)) else pick(new_states)
+        return (paddle.to_tensor(token), paddle.to_tensor(
+            new_lp.astype(np.float32)), new_states,
+            paddle.to_tensor(new_fin), paddle.to_tensor(parent))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major
+                   =False, impute_finished=False, is_test=False,
+                   return_length=False, **kwargs):
+    """Run a decoder until every beam finishes or max_step_num (reference:
+    nn/layer/rnn.py dynamic_decode)."""
+    import paddle_tpu as paddle
+    tok, lp, states, finished = decoder.initialize(inits)
+    ids_steps, parent_steps = [], []
+    steps = max_step_num or 64
+    for _ in range(steps):
+        tok, lp, states, finished, parent = decoder.step(
+            tok, lp, states, finished)
+        ids_steps.append(np.asarray(tok.numpy()))
+        parent_steps.append(np.asarray(parent.numpy()))
+        if bool(np.asarray(finished.numpy()).all()):
+            break
+    ids = paddle.to_tensor(np.stack(ids_steps))        # [T, B, K]
+    parents = paddle.to_tensor(np.stack(parent_steps))
+    full = F.gather_tree(ids, parents)
+    if not output_time_major:
+        full = paddle.to_tensor(
+            np.transpose(np.asarray(full.numpy()), (1, 2, 0)))
+    if return_length:
+        arr = np.asarray(full.numpy())
+        time_axis = 0 if output_time_major else -1
+        lens = (arr != decoder.end_token).sum(time_axis)
+        return full, lp, paddle.to_tensor(lens)
+    return full, lp

@@ -1,0 +1,397 @@
+"""nn breadth batch: unpool/fractional/grid_sample/rnnt/adaptive-softmax/
+margin losses/beam search (reference: the per-op suites under
+test/legacy_test/ for each).  torch is the oracle where it implements the
+same op."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(0)
+
+
+class TestVision:
+    def test_affine_grid_matches_torch(self):
+        import torch
+        theta = rng.rand(2, 2, 3).astype(np.float32)
+        got = F.affine_grid(paddle.to_tensor(theta), [2, 3, 5, 7],
+                            align_corners=True).numpy()
+        want = torch.nn.functional.affine_grid(
+            torch.from_numpy(theta), [2, 3, 5, 7],
+            align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("align", [True, False])
+    def test_grid_sample_matches_torch(self, mode, align):
+        import torch
+        x = rng.rand(2, 3, 6, 5).astype(np.float32)
+        grid = (rng.rand(2, 4, 4, 2).astype(np.float32) * 2.2 - 1.1)
+        got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            mode=mode, align_corners=align).numpy()
+        want = torch.nn.functional.grid_sample(
+            torch.from_numpy(x), torch.from_numpy(grid), mode=mode,
+            padding_mode="zeros", align_corners=align).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_temporal_shift_shapes_and_zero_pad(self):
+        x = paddle.to_tensor(rng.rand(4, 8, 3, 3).astype(np.float32))
+        out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+        assert list(out.shape) == [4, 8, 3, 3]
+        # last time step's shift-back channels come from zeros
+        np.testing.assert_allclose(out.numpy()[1::2][:, :2],
+                                   np.zeros((2, 2, 3, 3)), atol=1e-7)
+
+
+class TestUnpool:
+    @pytest.mark.parametrize("spatial", [1, 2])
+    def test_roundtrip_matches_torch(self, spatial):
+        import torch
+        if spatial == 1:
+            x = rng.rand(2, 3, 8).astype(np.float32)
+            out, mask = F.max_pool1d(paddle.to_tensor(x), 2, stride=2,
+                                     return_mask=True)
+            up = F.max_unpool1d(out, mask, 2, stride=2)
+            t_out, t_idx = torch.nn.functional.max_pool1d(
+                torch.from_numpy(x), 2, stride=2, return_indices=True)
+            t_up = torch.nn.functional.max_unpool1d(t_out, t_idx, 2,
+                                                    stride=2)
+        else:
+            x = rng.rand(2, 3, 8, 6).astype(np.float32)
+            out, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                                     return_mask=True)
+            up = F.max_unpool2d(out, mask, 2, stride=2)
+            t_out, t_idx = torch.nn.functional.max_pool2d(
+                torch.from_numpy(x), 2, stride=2, return_indices=True)
+            t_up = torch.nn.functional.max_unpool2d(t_out, t_idx, 2,
+                                                    stride=2)
+        np.testing.assert_allclose(up.numpy(), t_up.numpy(), atol=1e-6)
+
+    def test_unpool_layer(self):
+        x = rng.rand(1, 2, 4, 4).astype(np.float32)
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, return_mask=True)
+        up = paddle.nn.MaxUnPool2D(2)(out, mask)
+        assert list(up.shape) == [1, 2, 4, 4]
+
+
+class TestFractionalPool:
+    def test_2d_shapes_and_coverage(self):
+        x = paddle.to_tensor(rng.rand(2, 3, 9, 7).astype(np.float32))
+        out = F.fractional_max_pool2d(x, output_size=(4, 3), random_u=0.3)
+        assert list(out.shape) == [2, 3, 4, 3]
+        # every output is a real input value and global max survives
+        assert float(out.numpy().max()) == pytest.approx(
+            float(x.numpy().max()))
+
+    def test_2d_mask_roundtrip(self):
+        x = paddle.to_tensor(rng.rand(1, 2, 8, 8).astype(np.float32))
+        out, mask = F.fractional_max_pool2d(x, (4, 4), random_u=0.4,
+                                            return_mask=True)
+        flat = x.numpy().reshape(1, 2, -1)
+        picked = np.take_along_axis(flat, mask.numpy().reshape(1, 2, -1),
+                                    axis=2)
+        np.testing.assert_allclose(picked.reshape(out.numpy().shape),
+                                   out.numpy(), atol=1e-6)
+
+    def test_3d(self):
+        x = paddle.to_tensor(rng.rand(1, 2, 6, 6, 6).astype(np.float32))
+        out = paddle.nn.FractionalMaxPool3D((2, 3, 2))(x)
+        assert list(out.shape) == [1, 2, 2, 3, 2]
+
+
+class TestLosses:
+    def test_multi_margin_matches_torch(self):
+        import torch
+        x = rng.rand(6, 5).astype(np.float32)
+        y = rng.randint(0, 5, 6)
+        got = F.multi_margin_loss(paddle.to_tensor(x),
+                                  paddle.to_tensor(y)).numpy()
+        want = torch.nn.functional.multi_margin_loss(
+            torch.from_numpy(x), torch.from_numpy(y)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_pairwise_distance_matches_torch(self):
+        import torch
+        a = rng.rand(4, 7).astype(np.float32)
+        b = rng.rand(4, 7).astype(np.float32)
+        got = F.pairwise_distance(paddle.to_tensor(a),
+                                  paddle.to_tensor(b)).numpy()
+        want = torch.nn.functional.pairwise_distance(
+            torch.from_numpy(a), torch.from_numpy(b)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_triplet_with_distance_swap(self):
+        crit = paddle.nn.TripletMarginWithDistanceLoss(margin=0.5,
+                                                       swap=True)
+        a, p, n = (paddle.to_tensor(rng.rand(5, 4).astype(np.float32))
+                   for _ in range(3))
+        loss = crit(a, p, n)
+        assert float(loss.numpy()) >= 0
+
+    def test_rnnt_loss_matches_numpy_dp(self):
+        """Forward-variable DP cross-check (the warprnnt ground truth)."""
+        B, T, U, V = 2, 4, 3, 5
+        logits = rng.rand(B, T, U + 1, V).astype(np.float32)
+        labels = rng.randint(1, V, (B, U)).astype(np.int64)
+        t_len = np.array([T, 3], np.int64)
+        u_len = np.array([U, 2], np.int64)
+
+        def ref_one(lg, lb, tl, ul):
+            lp = lg - np.log(np.exp(lg - lg.max(-1, keepdims=True)).sum(
+                -1, keepdims=True)) - lg.max(-1, keepdims=True)
+            alpha = np.full((tl, ul + 1), -np.inf)
+            alpha[0, 0] = 0.0
+            for t in range(tl):
+                for u in range(ul + 1):
+                    if t == 0 and u == 0:
+                        continue
+                    c = []
+                    if t > 0:
+                        c.append(alpha[t - 1, u] + lp[t - 1, u, 0])
+                    if u > 0:
+                        c.append(alpha[t, u - 1] + lp[t, u - 1, lb[u - 1]])
+                    alpha[t, u] = np.logaddexp.reduce(c)
+            return -(alpha[tl - 1, ul] + lp[tl - 1, ul, 0])
+
+        want = np.array([ref_one(logits[b], labels[b], t_len[b], u_len[b])
+                         for b in range(B)])
+        got = F.rnnt_loss(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels),
+                          paddle.to_tensor(t_len),
+                          paddle.to_tensor(u_len),
+                          blank=0, reduction="none").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_rnnt_loss_grad_flows(self):
+        x = paddle.to_tensor(rng.rand(1, 3, 3, 4).astype(np.float32))
+        x.stop_gradient = False
+        loss = F.rnnt_loss(x, paddle.to_tensor(np.array([[1, 2]],
+                                                        np.int64)),
+                           paddle.to_tensor(np.array([3], np.int64)),
+                           paddle.to_tensor(np.array([2], np.int64)))
+        loss.backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_adaptive_log_softmax_matches_torch(self):
+        import torch
+        N, D, C = 8, 16, 20
+        cutoffs = [10, 15]
+        ours = paddle.nn.AdaptiveLogSoftmaxWithLoss(D, C, cutoffs,
+                                                    div_value=2.0)
+        theirs = torch.nn.AdaptiveLogSoftmaxWithLoss(
+            D, C, cutoffs, div_value=2.0, head_bias=False)
+        # copy our weights into torch (torch stores [out, in])
+        with torch.no_grad():
+            theirs.head.weight.copy_(torch.from_numpy(
+                ours.head_weight.numpy().T))
+            for i, (proj, cls_w) in enumerate(ours.tail_weights):
+                theirs.tail[i][0].weight.copy_(
+                    torch.from_numpy(proj.numpy().T))
+                theirs.tail[i][1].weight.copy_(
+                    torch.from_numpy(cls_w.numpy().T))
+        x = rng.rand(N, D).astype(np.float32)
+        y = rng.randint(0, C, N)
+        out, loss = ours(paddle.to_tensor(x), paddle.to_tensor(y))
+        t_out, t_loss = theirs(torch.from_numpy(x), torch.from_numpy(y))
+        np.testing.assert_allclose(out.numpy(), t_out.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   float(t_loss.detach()), rtol=1e-5)
+
+    def test_margin_cross_entropy_reduces_to_softmax_ce(self):
+        """m1=1, m2=m3=0: exactly scaled softmax CE."""
+        logits = (rng.rand(6, 8).astype(np.float32) * 2 - 1) * 0.9
+        y = rng.randint(0, 8, 6)
+        got = F.margin_cross_entropy(paddle.to_tensor(logits),
+                                     paddle.to_tensor(y), margin1=1.0,
+                                     margin2=0.0, margin3=0.0,
+                                     scale=10.0).numpy()
+        s = logits * 10.0
+        lp = s - np.log(np.exp(s - s.max(1, keepdims=True)).sum(
+            1, keepdims=True)) - s.max(1, keepdims=True)
+        want = -lp[np.arange(6), y].mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_hsigmoid_loss_trains(self):
+        head = paddle.nn.HSigmoidLoss(8, 6)
+        x = paddle.to_tensor(rng.rand(10, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 6, 10).astype(np.int64))
+        opt = paddle.optimizer.SGD(0.5, parameters=head.parameters())
+        losses = []
+        for _ in range(10):
+            loss = head(x, y).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestSequenceUtils:
+    def test_sequence_mask(self):
+        m = F.sequence_mask(paddle.to_tensor(np.array([1, 3], np.int64)),
+                            maxlen=4)
+        np.testing.assert_array_equal(m.numpy(),
+                                      [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_gather_tree_walks_parents(self):
+        # T=2, B=1, beam=2: step-1 beams both descend from beam 1
+        ids = paddle.to_tensor(np.array(
+            [[[5, 6]], [[7, 8]]], np.int64))
+        parents = paddle.to_tensor(np.array(
+            [[[0, 0]], [[1, 1]]], np.int64))
+        full = F.gather_tree(ids, parents).numpy()
+        np.testing.assert_array_equal(full[:, 0, 0], [6, 7])
+        np.testing.assert_array_equal(full[:, 0, 1], [6, 8])
+
+    def test_class_center_sample(self):
+        lbl = paddle.to_tensor(np.array([2, 9, 2, 17], np.int64))
+        remapped, sampled = F.class_center_sample(lbl, 20, 8)
+        s = sampled.numpy()
+        assert {2, 9, 17} <= set(s.tolist())
+        assert len(s) == 8
+        # remapped labels index into sampled
+        np.testing.assert_array_equal(s[remapped.numpy()],
+                                      lbl.numpy())
+
+
+class TestContainersAndActivations:
+    def test_layer_dict(self):
+        d = paddle.nn.LayerDict({"a": paddle.nn.Linear(2, 2)})
+        d["b"] = paddle.nn.ReLU()
+        assert set(d.keys()) == {"a", "b"}
+        assert len(d.parameters()) == 2  # from the Linear
+        del d["a"]
+        assert "a" not in d
+
+    def test_softmax2d_unflatten(self):
+        x = paddle.to_tensor(rng.rand(2, 3, 4, 4).astype(np.float32))
+        out = paddle.nn.Softmax2D()(x)
+        np.testing.assert_allclose(out.numpy().sum(1), 1.0, rtol=1e-5)
+        u = paddle.nn.Unflatten(1, [3, 1])(x)
+        assert list(u.shape) == [2, 3, 1, 4, 4]
+
+    def test_inplace_activations(self):
+        x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+        F.relu_(x)
+        np.testing.assert_allclose(x.numpy(), [0.0, 2.0])
+        y = paddle.to_tensor(np.array([-5.0, 5.0], np.float32))
+        F.hardtanh_(y)
+        np.testing.assert_allclose(y.numpy(), [-1.0, 1.0])
+
+
+class TestBeamSearch:
+    def test_dynamic_decode_greedy_path(self):
+        """Deterministic 'cell' whose logits always prefer token 2 then
+        end: beam search must return that path."""
+        V = 4
+
+        class Cell:
+            def __call__(self, inp, state):
+                import paddle_tpu as paddle
+                n = inp.shape[0]
+                base = np.full((int(n), V), -5.0, np.float32)
+                step = int(np.asarray(state.numpy()).reshape(-1)[0])
+                if step == 0:
+                    base[:, 2] = 5.0
+                else:
+                    base[:, 3] = 5.0   # end token
+                return (paddle.to_tensor(base),
+                        paddle.to_tensor(
+                            np.asarray(state.numpy()) + 1))
+
+        dec = paddle.nn.BeamSearchDecoder(
+            Cell(), start_token=0, end_token=3, beam_size=2)
+        init = paddle.to_tensor(np.zeros((1, 1), np.float32))
+        ids, lp = paddle.nn.dynamic_decode(dec, init, max_step_num=5)
+        best = ids.numpy()[0, 0]   # [B, K, T]
+        assert best[0] == 2 and 3 in best.tolist()
+
+
+def test_packed_flash_wrappers():
+    qkv = paddle.to_tensor(rng.rand(2, 8, 3, 2, 4).astype(np.float32))
+    out, _ = F.flash_attn_qkvpacked(qkv, causal=True)
+    assert list(out.shape) == [2, 8, 2, 4]
+
+
+def test_sparse_mask_flash_matches_dense_causal_when_start_zero():
+    q = paddle.to_tensor(rng.rand(1, 6, 2, 4).astype(np.float32))
+    k = paddle.to_tensor(rng.rand(1, 6, 2, 4).astype(np.float32))
+    v = paddle.to_tensor(rng.rand(1, 6, 2, 4).astype(np.float32))
+    starts = paddle.to_tensor(np.zeros(6, np.int32))
+    got = F.flash_attention_with_sparse_mask(q, k, v, starts).numpy()
+    ref, _ = F.flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, ref.numpy(), atol=2e-3)
+
+
+def test_sparse_mask_per_batch_head_starts():
+    """[B, H, S] start rows apply per batch and head (not just b0/h0)."""
+    q = paddle.to_tensor(rng.rand(2, 4, 2, 4).astype(np.float32))
+    starts = np.zeros((2, 2, 4), np.int32)
+    starts[1, 1, :] = 2   # batch 1 head 1: rows attend only from key 2 on
+    got = F.flash_attention_with_sparse_mask(
+        q, q, q, paddle.to_tensor(starts)).numpy()
+    ref, _ = F.flash_attention(q, q, q, causal=True)
+    # batch 0 matches dense causal; batch 1 head 1 differs
+    np.testing.assert_allclose(got[0], ref.numpy()[0], atol=2e-3)
+    assert not np.allclose(got[1, :, 1], ref.numpy()[1, :, 1], atol=1e-4)
+
+
+def test_pool_mask_nhwc_and_asymmetric_padding():
+    import torch
+    x = rng.rand(1, 3, 6, 6).astype(np.float32)
+    # NHWC mask must equal the NCHW mask transposed
+    out_c, m_c = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                              return_mask=True)
+    x_hwc = np.transpose(x, (0, 2, 3, 1))
+    out_h, m_h = F.max_pool2d(paddle.to_tensor(x_hwc), 2, stride=2,
+                              return_mask=True, data_format="NHWC")
+    np.testing.assert_array_equal(
+        np.transpose(m_h.numpy(), (0, 3, 1, 2)), m_c.numpy())
+    # pair-form padding works and matches torch's symmetric case
+    out_p, m_p = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                              padding=[[1, 1], [1, 1]], return_mask=True)
+    t_out, t_idx = torch.nn.functional.max_pool2d(
+        torch.from_numpy(x), 2, stride=2, padding=1, return_indices=True)
+    np.testing.assert_array_equal(m_p.numpy(), t_idx.numpy())
+
+
+def test_fractional_kernel_size_rejected():
+    x = paddle.to_tensor(rng.rand(1, 2, 8, 8).astype(np.float32))
+    with pytest.raises(NotImplementedError, match="kernel_size"):
+        F.fractional_max_pool2d(x, (4, 4), kernel_size=3)
+
+
+def test_rnnt_fastemit_scales_label_grads_only():
+    """FastEmit leaves the loss value unchanged but scales label-emission
+    gradients by (1+lambda)."""
+    logits = rng.rand(1, 3, 3, 4).astype(np.float32)
+    args = (paddle.to_tensor(np.array([[1, 2]], np.int64)),
+            paddle.to_tensor(np.array([3], np.int64)),
+            paddle.to_tensor(np.array([2], np.int64)))
+    x0 = paddle.to_tensor(logits)
+    l0 = F.rnnt_loss(x0, *args, fastemit_lambda=0.0)
+    x1 = paddle.to_tensor(logits)
+    l1 = F.rnnt_loss(x1, *args, fastemit_lambda=0.5)
+    np.testing.assert_allclose(float(l0.numpy()), float(l1.numpy()),
+                               rtol=1e-6)
+    x0.stop_gradient = False
+    F.rnnt_loss(x0, *args, fastemit_lambda=0.0).backward()
+    x1.stop_gradient = False
+    F.rnnt_loss(x1, *args, fastemit_lambda=0.5).backward()
+    assert not np.allclose(x0.grad.numpy(), x1.grad.numpy(), atol=1e-7)
+
+
+def test_varlen_qkvpacked_default_scale_is_rsqrt_d():
+    qkv = rng.rand(10, 3, 2, 16).astype(np.float32)
+    cu = np.array([0, 4, 10], np.int32)
+    out_default, _ = F.flash_attn_varlen_qkvpacked(
+        paddle.to_tensor(qkv), paddle.to_tensor(cu), paddle.to_tensor(cu),
+        4, 6)
+    out_explicit, _ = F.flash_attn_varlen_qkvpacked(
+        paddle.to_tensor(qkv), paddle.to_tensor(cu), paddle.to_tensor(cu),
+        4, 6, scale=0.25)
+    np.testing.assert_allclose(out_default.numpy(), out_explicit.numpy(),
+                               atol=1e-6)
