@@ -1,5 +1,6 @@
 """Probability distributions (reference: python/paddle/distribution/)."""
-from .distributions import (Bernoulli, Beta, Binomial, Categorical,  # noqa: F401
+from .distributions import (ExponentialFamily, register_kl,  # noqa: F401
+                            Bernoulli, Beta, Binomial, Categorical,  # noqa: F401
                             Cauchy, ContinuousBernoulli, Dirichlet,
                             Distribution, Exponential, Gamma, Geometric,
                             Gumbel, Independent, Laplace, LogNormal,

@@ -617,7 +617,65 @@ class TransformedDistribution(Distribution):
         return _w(_d(self.base.log_prob(y)) - log_det)
 
 
+# user-registered (type_p, type_q) -> fn table, consulted first
+# (reference: python/paddle/distribution/kl.py register_kl)
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a custom KL rule (reference:
+    distribution/kl.py register_kl)."""
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return decorator
+
+
+class ExponentialFamily(Distribution):
+    """Base class for exponential-family distributions (reference:
+    distribution/exponential_family.py).  Subclasses define
+    _natural_parameters and _log_normalizer; entropy comes from the
+    Bregman identity via jax autodiff."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """-E[log p(x)] = logA(eta) - <eta, grad logA> + E[carrier]."""
+        nat = [jnp.asarray(_d(p)) for p in self._natural_parameters]
+        grads = jax.grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=tuple(range(len(nat))))(*nat)
+        ent = self._log_normalizer(*nat) - sum(
+            (n * g for n, g in zip(nat, grads)),
+            start=jnp.zeros_like(nat[0]))
+        # reference convention: entropy = -E[log h] + logA - <eta, grad logA>
+        # (exponential_family.py:54)
+        return _w(ent - self._mean_carrier_measure)
+
+
 def kl_divergence(p, q):
+    # most-specific registered rule wins, walking both MROs (reference:
+    # distribution/kl.py dispatch)
+    best = None
+    for cp in type(p).__mro__:
+        for cq in type(q).__mro__:
+            fn = _KL_REGISTRY.get((cp, cq))
+            if fn is not None:
+                best = fn
+                break
+        if best is not None:
+            break
+    if best is not None:
+        return best(p, q)
     if isinstance(p, Normal) and isinstance(q, Normal):
         var_ratio = (p.scale / q.scale) ** 2
         t1 = ((p.loc - q.loc) / q.scale) ** 2

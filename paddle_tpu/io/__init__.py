@@ -443,3 +443,19 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample a fixed index subset in random order (reference:
+    io/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as np
+        order = np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in order])
+
+    def __len__(self):
+        return len(self.indices)

@@ -498,3 +498,20 @@ def in_static_mode():
 
 def enable_to_static(flag=True):
     pass
+
+
+# -- dy2static logging knobs (reference: jit/dy2static/logging_utils.py) ----
+_CODE_LEVEL = [0]
+_VERBOSITY = [0]
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """API-parity knob.  The reference's dy2static prints the transformed
+    source at this level; here tracing is jax.jit, so there is no
+    transformed source to print — the value is stored for introspection
+    only."""
+    _CODE_LEVEL[0] = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    _VERBOSITY[0] = level

@@ -99,6 +99,10 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
     """Sample x [N,C,H,W] at normalized grid [N,Ho,Wo,2] (reference:
     nn/functional/vision.py grid_sample -> grid_sample kernel).  Gather +
     lerp — XLA fuses it into the surrounding program."""
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sample: padding_mode={padding_mode!r} is not supported "
+            "(zeros/border are; reflection is not)")
     def fn(v, g):
         N, C, H, W = v.shape
         gx, gy = g[..., 0], g[..., 1]
@@ -216,14 +220,11 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
 # -- losses -------------------------------------------------------------------
 def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
     """Row-wise p-distance (reference: nn/functional/distance.py)."""
-    return apply_op(
-        "pairwise_distance",
-        lambda a, b: jnp.power(
-            jnp.sum(jnp.power(jnp.abs(a - b + epsilon), p), axis=-1),
-            1.0 / p)[..., None] if keepdim else jnp.power(
-                jnp.sum(jnp.power(jnp.abs(a - b + epsilon), p), axis=-1),
-                1.0 / p),
-        _t(x), _t(y))
+    def fn(a, b):
+        d = jnp.power(jnp.sum(jnp.power(jnp.abs(a - b + epsilon), p),
+                              axis=-1), 1.0 / p)
+        return d[..., None] if keepdim else d
+    return apply_op("pairwise_distance", fn, _t(x), _t(y))
 
 
 def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
@@ -374,6 +375,11 @@ def flash_attention_with_sparse_mask(query, key, value,
                          kd.astype(jnp.float32)) / jnp.sqrt(float(d))
         att = att + logits_mask
         p = jax.nn.softmax(att, axis=-1)
+        if dropout_p:
+            from ...tensor.random import _next_key
+            keep = jax.random.bernoulli(_next_key(), 1.0 - dropout_p,
+                                        p.shape)
+            p = p * keep / (1.0 - dropout_p)
         return jnp.einsum("bhst,bthd->bshd", p.astype(vd.dtype), vd)
     return apply_op("flash_attention_with_sparse_mask", fn, _t(query),
                     _t(key), _t(value), _t(attn_mask_start_row_indices))
@@ -407,10 +413,16 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
     oh, ow = output_size
     xin = _t(x)
     N, C, H, W = [int(s) for s in xin.shape]
-    u = float(random_u) if random_u is not None else float(
-        np.random.RandomState(0).uniform(0.05, 0.95))
-    eh = _fractional_edges(H, oh, u)
-    ew = _fractional_edges(W, ow, u)
+    if random_u is not None:
+        uh = uw = float(random_u)
+    else:  # fresh draw per call AND per dim (the Graham-2014 stochasticity)
+        import jax as _jax
+
+        from ...tensor.random import _next_key
+        uh, uw = np.asarray(_jax.random.uniform(
+            _next_key(), (2,), minval=0.05, maxval=0.95))
+    eh = _fractional_edges(H, oh, uh)
+    ew = _fractional_edges(W, ow, uw)
     row_bin = np.searchsorted(eh[1:], np.arange(H), side="right")
     col_bin = np.searchsorted(ew[1:], np.arange(W), side="right")
     rb, cb = jnp.asarray(row_bin), jnp.asarray(col_bin)
@@ -453,11 +465,17 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
     od, oh, ow = output_size
     xin = _t(x)
     N, C, D, H, W = [int(s) for s in xin.shape]
-    u = float(random_u) if random_u is not None else float(
-        np.random.RandomState(0).uniform(0.05, 0.95))
-    ed = _fractional_edges(D, od, u)
-    eh = _fractional_edges(H, oh, u)
-    ew = _fractional_edges(W, ow, u)
+    if random_u is not None:
+        ud = uh = uw = float(random_u)
+    else:
+        import jax as _jax
+
+        from ...tensor.random import _next_key
+        ud, uh, uw = np.asarray(_jax.random.uniform(
+            _next_key(), (3,), minval=0.05, maxval=0.95))
+    ed = _fractional_edges(D, od, ud)
+    eh = _fractional_edges(H, oh, uh)
+    ew = _fractional_edges(W, ow, uw)
     db = jnp.asarray(np.searchsorted(ed[1:], np.arange(D), side="right"))
     rb = jnp.asarray(np.searchsorted(eh[1:], np.arange(H), side="right"))
     cb = jnp.asarray(np.searchsorted(ew[1:], np.arange(W), side="right"))

@@ -32,6 +32,21 @@ def _pool(x, op_name, reducer, init, kernel_size, stride, padding, spatial,
         pads = [(0, 0)] + (pad if isinstance(pad, list) else pad) + [(0, 0)]
     if isinstance(pad, str):
         pads = pad
+    elif ceil_mode:
+        # include the last partial window: extend the trailing pad so
+        # reduce_window emits ceil((L + pb + pa - k)/s) + 1 positions
+        # (reference pooling.cc ceil-mode formula); padded cells contribute
+        # init (-inf for max, 0 for sum) and the avg `counts` pass sees the
+        # same padding, so they never skew results
+        x_sp = ([int(s) for s in _t(x).shape[2:]] if nc_first
+                else [int(s) for s in _t(x).shape[1:-1]])
+        off = 2 if nc_first else 1
+        for i in range(spatial):
+            pb, pa = pads[off + i]
+            total = x_sp[i] + pb + pa - ks[i]
+            rem = total % st[i]
+            if rem:
+                pads[off + i] = (pb, pa + (st[i] - rem))
 
     def fn(v):
         if reducer == "max":

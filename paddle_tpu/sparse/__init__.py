@@ -306,3 +306,39 @@ class nn:
 
     from ..nn import ReLU, ReLU6, LeakyReLU, Softmax, BatchNorm  # noqa: F401
     from ..nn import Conv2D, Conv3D  # noqa: F401
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) with sparse operands densified
+    (reference: sparse/binary.py addmm — same dense-backing policy as
+    matmul above)."""
+    from ..tensor.math import addmm as dense_addmm
+    dn = lambda t: t.to_dense() if hasattr(t, "to_dense") else t
+    return dense_addmm(dn(input), dn(x), dn(y), beta=beta, alpha=alpha)
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Slice a sparse tensor; result stays sparse (reference:
+    sparse/unary.py slice)."""
+    import builtins
+    d = x.to_dense() if hasattr(x, "to_dense") else x
+    sl = [builtins.slice(None)] * d.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        sl[int(ax)] = builtins.slice(int(s), int(e))
+    sub = Tensor._wrap(d._data[tuple(sl)])
+    if isinstance(x, SparseCsrTensor):
+        return to_sparse_csr(sub)
+    return to_sparse_coo(sub)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (reference: sparse/multiary.py pca_lowrank over
+    svd_lowrank); sparse input densifies, the factorisation itself is the
+    same randomized SVD the dense path uses."""
+    from ..tensor.linalg import svd_lowrank
+    d = x.to_dense() if hasattr(x, "to_dense") else x
+    if q is None:
+        q = min(6, int(d.shape[-2]), int(d.shape[-1]))
+    if center:
+        d = d - d.mean(axis=-2, keepdim=True)
+    return svd_lowrank(d, q=q, niter=niter)

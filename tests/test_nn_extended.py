@@ -395,3 +395,41 @@ def test_varlen_qkvpacked_default_scale_is_rsqrt_d():
         4, 6, scale=0.25)
     np.testing.assert_allclose(out_default.numpy(), out_explicit.numpy(),
                                atol=1e-6)
+
+
+class TestCeilMode:
+    """ceil_mode was silently ignored in _pool (pre-existing); torch is the
+    oracle for all three fixed paths."""
+
+    def test_max_pool1d(self):
+        import torch
+        x = rng.rand(1, 1, 5).astype(np.float32)
+        got = F.max_pool1d(paddle.to_tensor(x), 2, stride=2,
+                           ceil_mode=True)
+        want = torch.nn.functional.max_pool1d(
+            torch.from_numpy(x), 2, stride=2, ceil_mode=True)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), atol=1e-6)
+
+    def test_avg_pool2d_padded_exclusive(self):
+        import torch
+        x = rng.rand(1, 2, 7, 7).astype(np.float32)
+        got = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                           ceil_mode=True, exclusive=True)
+        want = torch.nn.functional.avg_pool2d(
+            torch.from_numpy(x), 3, stride=2, padding=1, ceil_mode=True,
+            count_include_pad=False)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), atol=1e-5)
+
+
+def test_grid_sample_reflection_rejected():
+    x = paddle.to_tensor(rng.rand(1, 1, 4, 4).astype(np.float32))
+    g = paddle.to_tensor(np.zeros((1, 2, 2, 2), np.float32))
+    with pytest.raises(NotImplementedError, match="reflection"):
+        F.grid_sample(x, g, padding_mode="reflection")
+
+
+def test_fractional_pool_random_u_varies_per_call():
+    x = paddle.to_tensor(rng.rand(1, 2, 9, 9).astype(np.float32))
+    outs = {F.fractional_max_pool2d(x, 4).numpy().tobytes()
+            for _ in range(6)}
+    assert len(outs) > 1  # stochastic regions, not a fixed seed
