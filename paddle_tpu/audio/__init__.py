@@ -1,3 +1,7 @@
-"""Audio features (reference: python/paddle/audio/)."""
-from . import functional  # noqa: F401
-from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401
+"""paddle.audio (reference: python/paddle/audio/__init__.py — features,
+functional, IO backends, datasets)."""
+
+from . import backends, datasets, features, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
+from .features import (MFCC, LogMelSpectrogram, MelSpectrogram,  # noqa: F401
+                       Spectrogram)

@@ -1,0 +1,99 @@
+"""Audio IO backends (reference: python/paddle/audio/backends/ — a
+wave_backend on the stdlib `wave` module plus optional soundfile).
+
+This build carries the same wave_backend: 16/32-bit PCM WAV via stdlib —
+no extra dependency, covers the dataset formats the reference ships."""
+
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample})")
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"audio backend {backend_name!r}: only wave_backend is "
+            "available (stdlib PCM WAV)")
+
+
+_WIDTH_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+def info(filepath):
+    """reference: audio/backends/wave_backend.py info."""
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """PCM WAV -> ([channels, samples] float tensor, sample_rate)
+    (reference: wave_backend.load)."""
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = num_frames if num_frames > 0 else f.getnframes() - frame_offset
+        raw = f.readframes(n)
+    dt = _WIDTH_DTYPE.get(width)
+    if dt is None:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    data = np.frombuffer(raw, dt).reshape(-1, nch)
+    if normalize:
+        scale = float(2 ** (width * 8 - 1))
+        data = data.astype(np.float32)
+        if width == 1:      # 8-bit WAV is unsigned with a 128 bias
+            data = data - 128.0
+        data = data / scale
+    out = data.T if channels_first else data
+    return Tensor._wrap(jnp.asarray(np.ascontiguousarray(out))), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    """Float tensor -> PCM WAV (reference: wave_backend.save)."""
+    data = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if channels_first:
+        data = data.T
+    if bits_per_sample not in (16, 32):
+        raise ValueError("bits_per_sample must be 16 or 32")
+    width = bits_per_sample // 8
+    scale = float(2 ** (bits_per_sample - 1) - 1)
+    pcm = np.clip(data, -1.0, 1.0)
+    pcm = (pcm * scale).astype(np.int16 if width == 2 else np.int32)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1] if data.ndim > 1 else 1)
+        f.setsampwidth(width)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(pcm).tobytes())

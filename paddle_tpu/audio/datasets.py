@@ -1,0 +1,12 @@
+"""Audio datasets namespace (reference: python/paddle/audio/datasets/ —
+TESS/ESC50 downloads).  Download is gated off in this air-gapped build."""
+
+from __future__ import annotations
+
+
+class _DownloadGated:
+    def __init__(self, *a, **k):
+        raise RuntimeError("dataset download disabled in this environment")
+
+
+TESS = ESC50 = _DownloadGated

@@ -53,3 +53,15 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
         for p in procs:
             p.join()
     return procs
+
+from . import io  # noqa: E402,F401
+from . import launch  # noqa: E402,F401
+from .compat import (CountFilterEntry, DistModel, InMemoryDataset,  # noqa: E402,F401
+                     ParallelMode, ProbabilityEntry, QueueDataset,
+                     ReduceType, ShardingStage1, ShardingStage2,
+                     ShardingStage3, ShowClickEntry, Strategy, alltoall,
+                     alltoall_single, broadcast_object_list,
+                     destroy_process_group, dtensor_from_fn, gather,
+                     gloo_barrier, gloo_init_parallel_env, gloo_release,
+                     is_available, scatter_object_list, shard_dataloader,
+                     shard_scaler, split, unshard_dtensor, wait)

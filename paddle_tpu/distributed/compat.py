@@ -1,0 +1,343 @@
+"""distributed namespace completion (reference: the paddle.distributed
+__all__ entries not covered by the core modules — enums, PS dataset/entry
+configs, auto-parallel sugar, gloo shims, object collectives)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+# -- enums -------------------------------------------------------------------
+class ParallelMode:
+    """reference: distributed/parallel.py ParallelMode."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """reference: auto_parallel/placement_type ReduceType."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+# -- PS table entry configs (reference: distributed/entry_attr.py) -----------
+class _Entry:
+    def __init__(self, kind, *args):
+        self._kind = kind
+        self._args = args
+
+    def _to_attr(self):
+        return ":".join([self._kind] + [str(a) for a in self._args])
+
+
+class ProbabilityEntry(_Entry):
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        super().__init__("probability_entry", probability)
+
+
+class CountFilterEntry(_Entry):
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        super().__init__("count_filter_entry", count_filter)
+
+
+class ShowClickEntry(_Entry):
+    def __init__(self, show_name, click_name):
+        super().__init__("show_click_entry", show_name, click_name)
+
+
+# -- PS datasets (reference: distributed/fleet/dataset/dataset.py) -----------
+class InMemoryDataset:
+    """Files loaded into memory, shuffled, iterated by the PS trainers.
+    The reference backs this with a C++ dataset; here host RAM + the
+    MultiSlot text protocol."""
+
+    def __init__(self):
+        self._files = []
+        self._samples = []
+        self._parser = None
+        self.use_var = []
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command="",
+             input_type=0, **kwargs):
+        self.batch_size = batch_size
+        self.use_var = use_var or []
+
+    update_settings = init
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        self._samples = []
+        for path in self._files:
+            with open(path) as f:
+                self._samples.extend(ln.rstrip("\n") for ln in f)
+
+    def local_shuffle(self):
+        np.random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+    def __iter__(self):
+        return iter(self._samples)
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant (reference: QueueDataset) — iterates files
+    directly without the load/shuffle step."""
+
+    def __iter__(self):
+        for path in self._files:
+            with open(path) as f:
+                yield from (ln.rstrip("\n") for ln in f)
+
+
+# -- auto-parallel sugar -----------------------------------------------------
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """Build a tensor via fn and shard it (reference:
+    auto_parallel/api.py dtensor_from_fn)."""
+    from .auto_parallel import shard_tensor
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather a dist tensor to a full replicated tensor (reference:
+    auto_parallel/api.py unshard_dtensor)."""
+    import jax
+
+    from ..core.tensor import Tensor
+    arr = dist_tensor._data
+    try:
+        arr = jax.device_get(arr)
+    except Exception:
+        arr = np.asarray(arr)
+    t = Tensor(np.asarray(arr))
+    t.stop_gradient = dist_tensor.stop_gradient
+    return t
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    """Wrap a dataloader so each batch lands sharded on the mesh
+    (reference: auto_parallel/api.py shard_dataloader).  With GSPMD the
+    per-batch device_put happens in the train step's sharding constraints,
+    so the loader passes through annotated."""
+    return dataloader
+
+
+def shard_scaler(scaler):
+    """reference: auto_parallel/api.py shard_scaler — the GradScaler's
+    found-inf reduction is already global under GSPMD; passthrough."""
+    return scaler
+
+
+class Strategy:
+    """Auto-parallel Strategy (reference: auto_parallel/strategy.py) — the
+    to_static twin of fleet.DistributedStrategy."""
+
+    class _Section(dict):
+        __getattr__ = dict.get
+
+        def __setattr__(self, k, v):
+            self[k] = v
+
+    def __init__(self, config=None):
+        self.sharding = Strategy._Section(enable=False, degree=1, stage=1)
+        self.fused_passes = Strategy._Section(enable=False)
+        self.gradient_merge = Strategy._Section(enable=False, k_steps=1)
+        self.pipeline = Strategy._Section(enable=False, schedule_mode="1F1B")
+        self.amp = Strategy._Section(enable=False, dtype="float16",
+                                     level="O1")
+        if config:
+            for k, v in dict(config).items():
+                cur = getattr(self, k, None)
+                if isinstance(cur, Strategy._Section) and isinstance(
+                        v, dict):
+                    cur.update(v)   # merge, keep attr-style access
+                else:
+                    setattr(self, k, v)
+
+
+class DistModel:
+    """reference: auto_parallel/api.py DistModel — the to_static product:
+    a layer + loader + loss + optimizer compiled for hybrid execution.
+    Thin veneer over distributed.engine's DistributedTrainStep."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train"
+        self._step = None
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+
+    def __call__(self, *args):
+        import paddle_tpu as paddle
+        if self._mode == "train":
+            if self._loss is None:
+                raise ValueError("DistModel train mode needs a loss")
+            out = self.network(*args[:-1])
+            loss = self._loss(out, args[-1])
+            loss.backward()
+            if self._optimizer is not None:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            return loss
+        with paddle.no_grad():
+            return self.network(*args)
+
+
+# -- sharding-stage API objects (reference: distributed/sharding/) -----------
+def _stage(level):
+    def apply(model, optimizer=None, group=None, **kwargs):
+        """Annotate params/grads/opt-state for ZeRO stage semantics; the
+        real sharding lives in fleet/parallel_apply.py over GSPMD."""
+        from .fleet.parallel_apply import apply_fsdp_annotations
+        apply_fsdp_annotations(model, stage=level)
+        return (model, optimizer) if optimizer is not None else model
+    apply.__name__ = f"ShardingStage{level}"
+    return apply
+
+
+ShardingStage1 = _stage(1)
+ShardingStage2 = _stage(2)
+ShardingStage3 = _stage(3)
+
+
+# -- collectives / runtime shims ---------------------------------------------
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    from .communication import all_to_all
+    return all_to_all(out_tensor_list, in_tensor_list, group, sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all: split in_tensor across ranks, exchange,
+    concatenate (reference: communication/all_to_all.py
+    alltoall_single)."""
+    from .communication import all_to_all
+    from .env import get_world_size
+    n = max(get_world_size(), 1)
+    import paddle_tpu as paddle
+    ins = paddle.split(in_tensor, n, axis=0) if in_split_sizes is None \
+        else paddle.split(in_tensor, list(in_split_sizes), axis=0)
+    outs = []
+    all_to_all(outs, ins, group, sync_op)
+    out = paddle.concat(outs, axis=0)
+    out_tensor._data = out._data
+    return out_tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """reference: communication/gather.py — all ranks send to dst."""
+    from .communication import all_gather
+    from .env import get_rank
+    tmp = []
+    all_gather(tmp, tensor, group)
+    if gather_list is not None and get_rank() == dst:
+        gather_list.extend(tmp)
+    return tmp if get_rank() == dst else None
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """reference: communication/broadcast.py broadcast_object_list —
+    single-host worlds share the list as-is; multi-host object transport
+    rides the PS rpc, not collectives."""
+    from .env import get_world_size
+    if get_world_size() > 1:
+        raise NotImplementedError(
+            "broadcast_object_list across hosts: serialize and use "
+            "broadcast on a uint8 tensor, or the PS rpc")
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    from .env import get_rank, get_world_size
+    n = get_world_size()
+    if n <= 1:
+        out_object_list.extend(in_object_list or [])
+        return
+    raise NotImplementedError(
+        "scatter_object_list across hosts: use the PS rpc or "
+        "broadcast_object_list")
+
+
+def destroy_process_group(group=None):
+    """reference: collective.py destroy_process_group."""
+    from .env import reset_parallel_env
+    reset_parallel_env()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference: collective.py wait — block until the tensor's pending
+    work is done (XLA: block_until_ready)."""
+    import jax
+    jax.block_until_ready(tensor._data)
+    return tensor
+
+
+def is_available():
+    """reference: distributed/__init__.py is_available."""
+    return True
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference: collective.py split — model-parallel fc/embedding split
+    helper.  GSPMD owns partitioning here; the fleet mp_layers are the
+    supported surface, so this raises with the pointer."""
+    raise NotImplementedError(
+        "paddle.distributed.split: use fleet.meta_parallel "
+        "ColumnParallelLinear/RowParallelLinear/VocabParallelEmbedding "
+        "(GSPMD shards them over the mesh)")
+
+
+# -- gloo shims (reference: gloo CPU rendezvous; jax.distributed fills this
+# role on TPU) ---------------------------------------------------------------
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    from .env import init_parallel_env
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    from .communication import barrier
+    barrier()
+
+
+def gloo_release():
+    pass

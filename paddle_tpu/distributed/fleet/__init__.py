@@ -169,3 +169,96 @@ def init_worker(endpoints=None):
 def stop_worker():
     from .. import ps
     return ps.stop_worker()
+
+
+# -- PS-mode shells (reference: fleet __all__) -------------------------------
+class Role:
+    """reference: fleet/base/role_maker.py Role enum."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class UtilBase:
+    """reference: fleet/base/util_factory.py — cross-worker small-data
+    utilities, realised over the collective API."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+        arr = np.asarray(input)
+        if mode not in ("sum", "max", "min"):
+            raise ValueError(f"all_reduce mode {mode!r}: sum/max/min")
+        from ..env import get_world_size
+        if get_world_size() <= 1:
+            return arr
+        from ..communication import all_reduce as _ar
+        import paddle_tpu as paddle
+        t = paddle.to_tensor(arr)
+        _ar(t)
+        return np.asarray(t.numpy())
+
+    def barrier(self, comm_world="worker"):
+        from ..communication import barrier
+        barrier()
+
+    def get_file_shard(self, files):
+        from ..env import get_rank, get_world_size
+        n, r = get_world_size(), get_rank()
+        return [f for i, f in enumerate(files) if i % n == r]
+
+    def print_on_rank(self, message, rank_id=0):
+        from ..env import get_rank
+        if get_rank() == rank_id:
+            print(message)
+
+
+class Fleet:
+    """The fleet singleton's type (reference: fleet/fleet.py Fleet).  The
+    module-level functions (init/init_server/...) are the instance surface;
+    this class exposes them object-style for code that instantiates it."""
+
+    def __init__(self):
+        self.util = UtilBase()
+
+    def __getattr__(self, item):
+        import sys
+        mod = sys.modules[__name__]
+        if hasattr(mod, item):
+            return getattr(mod, item)
+        raise AttributeError(item)
+
+
+class MultiSlotDataGenerator:
+    """PS-training data generator emitting the multi-slot text protocol
+    (reference: fleet/data_generator/data_generator.py): each sample is
+    [(slot_name, [ints]), ...] serialized as 'count id id ...' per slot."""
+
+    def _gen_str(self, line):
+        parts = []
+        for name, values in line:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+    def generate_sample(self, line):
+        raise NotImplementedError("override generate_sample")
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            for sample in self.generate_sample(line)():
+                sys.stdout.write(self._gen_str(sample))
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            for sample in self.generate_sample(line)():
+                out.append(self._gen_str(sample))
+        return out
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-valued slots variant (reference: data_generator.py)."""

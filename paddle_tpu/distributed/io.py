@@ -1,0 +1,25 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py —
+persistables save/load for distributed training; here delegating to the
+sharded checkpoint module which owns dedup + reshard-on-load)."""
+
+from __future__ import annotations
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """reference: distributed/io.py save_persistables.  In this framework
+    a Layer's state_dict + distributed.checkpoint.save cover the same
+    contract."""
+    raise NotImplementedError(
+        "static persistables: use paddle_tpu.distributed.checkpoint.save "
+        "(sharded, crash-safe) or paddle_tpu.save(layer.state_dict(), path)")
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    raise NotImplementedError(
+        "static persistables: use paddle_tpu.distributed.checkpoint.load")
+
+
+def is_persistable(var):
+    return getattr(var, "persistable", False)

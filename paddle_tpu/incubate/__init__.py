@@ -36,3 +36,160 @@ class asp:
                 np.put_along_axis(mask, idx, 0.0, axis=1)
                 lay.weight._data = jnp.asarray((flat * mask).reshape(w.shape))
         return model
+
+
+# -- graph / segment ops (reference: incubate/operators/graph_*.py; the
+# geometric module carries the real implementations) -------------------------
+from ..geometric import (segment_max, segment_mean, segment_min,  # noqa: F401,E402
+                         segment_sum)
+from ..geometric import sample_neighbors as graph_sample_neighbors  # noqa: F401,E402
+from ..geometric import reindex_graph as graph_reindex  # noqa: F401,E402
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """reference: incubate/operators/graph_send_recv.py — renamed
+    geometric.send_u_recv."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       return_eids=False, name=None):
+    """Multi-hop neighbor sampling: iterate geometric.sample_neighbors per
+    hop (reference: incubate/operators/graph_khop_sampler.py)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..geometric import sample_neighbors
+    if return_eids:
+        raise NotImplementedError("graph_khop_sampler: return_eids")
+    cur = input_nodes
+    all_src, all_dst = [], []
+    for k in sample_sizes:
+        srcs, counts = sample_neighbors(row, colptr, cur, sample_size=k)
+        s = np.asarray(srcs.numpy())
+        c = np.asarray(counts.numpy())
+        d = np.repeat(np.asarray(cur.numpy()
+                                 if hasattr(cur, "numpy") else cur), c)
+        all_src.append(s)
+        all_dst.append(d)
+        cur = Tensor(np.unique(s))
+    import jax.numpy as jnp
+    edge_src = Tensor._wrap(jnp.asarray(np.concatenate(all_src)))
+    edge_dst = Tensor._wrap(jnp.asarray(np.concatenate(all_dst)))
+    return edge_src, edge_dst, cur
+
+
+def identity_loss(x, reduction="none"):
+    """reference: incubate/operators/identity_loss.py — marks x as the loss
+    (used by custom backward recipes); reduction mirrors the op attr."""
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 1):
+        return x.sum()
+    return x.mean()  # 'mean' / 0
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (reference:
+    incubate/operators/softmax_mask_fuse.py; XLA fuses the chain)."""
+    import paddle_tpu as paddle
+    return paddle.nn.functional.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax with the causal (upper-triangle masked) pattern fused
+    (reference: softmax_mask_fuse_upper_triangle.py)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_op
+    from ..core.tensor import Tensor
+
+    def fn(v):
+        import jax
+        S = v.shape[-1]
+        mask = jnp.tril(jnp.ones((v.shape[-2], S), bool))
+        return jax.nn.softmax(jnp.where(mask, v, -1e9), axis=-1)
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return apply_op("softmax_mask_fuse_upper_triangle", fn, x)
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (Zhang et al. 2019; reference:
+    incubate/optimizer/lookahead.py): every k steps pull slow weights
+    toward fast weights by alpha and restart."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._step = 0
+        self._slow = None
+
+    def step(self):
+        import paddle_tpu as paddle
+        self.inner_optimizer.step()
+        params = self.inner_optimizer._parameter_list
+        if self._slow is None:
+            self._slow = [p.numpy().copy() for p in params]
+        self._step += 1
+        if self._step % self.k:
+            return
+        import numpy as np
+        with paddle.no_grad():
+            for p, s in zip(params, self._slow):
+                new_slow = s + self.alpha * (np.asarray(p.numpy()) - s)
+                p.set_value(paddle.to_tensor(new_slow.astype(s.dtype)))
+            self._slow = [p.numpy().copy() for p in params]
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Running parameter average applied at eval (reference:
+    incubate/optimizer/modelaverage.py).  apply()/restore() swap the
+    averaged weights in and out."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sum = None
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        import numpy as np
+        if self._sum is None:
+            self._sum = [np.zeros(tuple(p.shape), np.float64)
+                         for p in self._params]
+        for s, p in zip(self._sum, self._params):
+            s += np.asarray(p.numpy(), np.float64)
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        import paddle_tpu as paddle
+        if not self._count:
+            return
+        self._backup = [p.numpy().copy() for p in self._params]
+        with paddle.no_grad():
+            for p, s, b in zip(self._params, self._sum, self._backup):
+                p.set_value(paddle.to_tensor(
+                    (s / self._count).astype(b.dtype)))
+
+    def restore(self, executor=None):
+        import paddle_tpu as paddle
+        if self._backup is None:
+            return
+        with paddle.no_grad():
+            for p, b in zip(self._params, self._backup):
+                p.set_value(paddle.to_tensor(b))
+        self._backup = None

@@ -3,3 +3,5 @@
 from . import functional  # noqa: F401
 from .layer import (FusedEcMoe, FusedFeedForward, FusedLinear,  # noqa: F401
                     FusedMultiHeadAttention, FusedTransformerEncoderLayer)
+from .layer import (FusedBiasDropoutResidualLayerNorm,  # noqa: F401
+                    FusedDropoutAdd, FusedMultiTransformer)

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor
 from ...nn.functional.init_utils import param_attr_init
 from ...nn.initializer import Constant, XavierUniform
-from ...nn.layer.layers import Layer
+from ...nn.layer.layers import Layer, LayerList
 from . import functional as F
 
 
@@ -173,3 +173,71 @@ class FusedEcMoe(Layer):
         return F.fused_ec_moe(x, gate, self.bmm0_weight,
                               squeeze1(self.bmm0_bias), self.bmm1_weight,
                               squeeze1(self.bmm1_bias), self.act_type)
+
+
+class FusedDropoutAdd(Layer):
+    """dropout(x) + y in one fused chain (reference:
+    incubate/nn/layer/fused_dropout_add.py; XLA fuses it)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from ...nn import functional as F
+        return F.dropout(x, self.p, training=self.training,
+                         mode=self.mode) + y
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """layer_norm(residual + dropout(x + bias)) fused (reference:
+    incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn.functional.init_utils import param_attr_init
+        from ...nn.initializer import Constant
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = param_attr_init((embed_dim,), self._dtype, None,
+                                           True, Constant(0.0))
+        self.ln_scale = param_attr_init((embed_dim,), self._dtype, None,
+                                        False, Constant(1.0))
+        self.ln_bias = param_attr_init((embed_dim,), self._dtype, None,
+                                       True, Constant(0.0))
+
+    def forward(self, x, residual):
+        from ...nn import functional as F
+        h = F.dropout(x + self.linear_bias, self.dropout_rate,
+                      training=self.training)
+        return F.layer_norm(residual + h, [self.embed_dim],
+                            weight=self.ln_scale, bias=self.ln_bias,
+                            epsilon=self._epsilon)
+
+
+class FusedMultiTransformer(Layer):
+    """Stack of fused transformer decoder blocks for generation (reference:
+    incubate/nn/layer/fused_transformer.py FusedMultiTransformer — the
+    inference-serving block).  Composes the framework's fused encoder
+    layer per depth; KV caching rides the model-level generation path
+    (models/gpt.py), which is the TPU-native home for it."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=1, name=None, **kwargs):
+        super().__init__()
+        self.layers = LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=normalize_before)
+            for _ in range(num_layers)])
+
+    def forward(self, x, attn_mask=None, caches=None, **kwargs):
+        for lyr in self.layers:
+            x = lyr(x, attn_mask)
+        return x

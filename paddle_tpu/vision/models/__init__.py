@@ -2,6 +2,8 @@
 all present)."""
 
 from .lenet import LeNet  # noqa: F401
+from .resnet import (resnext101_32x4d, resnext152_32x4d,  # noqa: F401
+                     resnext152_64x4d, resnext50_64x4d)
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
                      resnet152, resnext50_32x4d, resnext101_64x4d,
                      wide_resnet50_2, wide_resnet101_2)
@@ -12,6 +14,8 @@ from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .mobilenetv3 import (MobileNetV3Large, MobileNetV3Small,  # noqa: F401
                           mobilenet_v3_large, mobilenet_v3_small)
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .shufflenetv2 import (shufflenet_v2_x0_25,  # noqa: F401
+                           shufflenet_v2_x0_33)
 from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_5,  # noqa: F401
                            shufflenet_v2_x1_0, shufflenet_v2_x1_5,
                            shufflenet_v2_x2_0, shufflenet_v2_swish)

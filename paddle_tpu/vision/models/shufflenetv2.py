@@ -128,3 +128,7 @@ def shufflenet_v2_swish(pretrained=False, **kwargs):
     if pretrained:
         raise RuntimeError("pretrained weights unavailable (zero egress)")
     return ShuffleNetV2(scale=1.0, act="swish", **kwargs)
+
+
+shufflenet_v2_x0_25 = _factory(0.25)
+shufflenet_v2_x0_33 = _factory(0.33)

@@ -556,3 +556,191 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         return p5[:, :, ii, jj, ii, jj]                   # [R, out_c, ph, pw]
 
     return apply_op("psroi_pool", fn, pooled)
+
+
+# -- layer wrappers (reference: vision/ops.py RoIAlign/RoIPool/PSRoIPool) ----
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes per feature-map cell (reference kernel:
+    phi/kernels/impl/prior_box_kernel_impl.h).  Pure index math, computed
+    host-side once per shape."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            cell = []
+            for s, ms in enumerate(min_sizes):
+                ms = float(ms)
+                cell.append((cx - ms / 2, cy - ms / 2,
+                             cx + ms / 2, cy + ms / 2))
+                if max_sizes:
+                    big = np.sqrt(ms * float(max_sizes[s]))
+                    cell.append((cx - big / 2, cy - big / 2,
+                                 cx + big / 2, cy + big / 2))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    w = ms * np.sqrt(ar)
+                    h = ms / np.sqrt(ar)
+                    cell.append((cx - w / 2, cy - h / 2,
+                                 cx + w / 2, cy + h / 2))
+            boxes.append(cell)
+    arr = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    arr[..., 0::2] /= iw
+    arr[..., 1::2] /= ih
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          arr.shape).copy()
+    return (Tensor._wrap(jnp.asarray(arr)),
+            Tensor._wrap(jnp.asarray(var)))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; reference kernel: phi/kernels/impl/
+    matrix_nms_kernel_impl.h): soft decay of each box's score by its IoU
+    with higher-scored same-class boxes — one matrix op, no sequential
+    suppression loop."""
+    b = np.asarray(bboxes._data if isinstance(bboxes, Tensor) else bboxes)
+    s = np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    N, C = s.shape[0], s.shape[1]
+    off = 0.0 if normalized else 1.0
+    outs, indices, counts = [], [], []
+    for n in range(N):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = s[n, c]
+            keep = np.where(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            bx = b[n, order]
+            x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+            area = (x2 - x1 + off) * (y2 - y1 + off)
+            ix1 = np.maximum(x1[:, None], x1[None, :])
+            iy1 = np.maximum(y1[:, None], y1[None, :])
+            ix2 = np.minimum(x2[:, None], x2[None, :])
+            iy2 = np.minimum(y2[:, None], y2[None, :])
+            inter = (np.clip(ix2 - ix1 + off, 0, None)
+                     * np.clip(iy2 - iy1 + off, 0, None))
+            iou = inter / (area[:, None] + area[None, :] - inter + 1e-10)
+            iou = np.triu(iou, k=1)   # pairwise with higher-scored only
+            n_ord = len(order)
+            # compensate[j] = j's own max IoU with ITS predecessors
+            # (matrix_nms_kernel_impl.h compensate_iou); decay_i =
+            # min over predecessors j of f(iou[j,i]) / f(compensate[j]),
+            # which is always <= 1 (j=0 has compensate 0)
+            comp = np.zeros(n_ord)
+            for j in range(1, n_ord):
+                comp[j] = iou[:j, j].max()
+            if use_gaussian:
+                ratios = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                                / gaussian_sigma)
+            else:
+                ratios = (1 - iou) / np.maximum(1 - comp[:, None], 1e-10)
+            # only j < i entries participate in the min
+            ratios = np.where(np.triu(np.ones_like(iou), k=1) > 0,
+                              ratios, np.inf)
+            decay = np.minimum(ratios.min(axis=0), 1.0)
+            decay[0] = 1.0
+            new_scores = sc[order] * decay
+            for k, idx in enumerate(order):
+                if new_scores[k] > post_threshold:
+                    dets.append((c, new_scores[k], *b[n, idx], idx))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        outs.extend(dets)
+        indices.extend(int(d[-1]) + n * s.shape[-1] for d in dets)
+        counts.append(len(dets))
+    out = (np.asarray([d[:-1] for d in outs], np.float32)
+           if outs else np.zeros((0, 6), np.float32))
+    res = [Tensor._wrap(jnp.asarray(out))]
+    if return_index:
+        res.append(Tensor._wrap(jnp.asarray(np.asarray(indices,
+                                                       np.int64))))
+    if return_rois_num:
+        res.append(Tensor._wrap(jnp.asarray(np.asarray(counts,
+                                                       np.int32))))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference: vision/ops.py
+    read_file)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor._wrap(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG bytes -> CHW uint8 tensor (reference: decode_jpeg op over
+    nvjpeg).  Host-side via PIL when available; raises with a clear
+    message otherwise (zero-egress image: PIL may be absent)."""
+    try:
+        import io as _io
+
+        from PIL import Image
+    except ImportError:
+        raise RuntimeError(
+            "decode_jpeg needs Pillow, which is not installed in this "
+            "environment; decode images host-side and feed arrays") from None
+    img = Image.open(_io.BytesIO(np.asarray(
+        x._data if isinstance(x, Tensor) else x).tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode != "unchanged":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor._wrap(jnp.asarray(arr))

@@ -274,3 +274,348 @@ def crop(img, top, left, height, width):
 
 def center_crop(img, output_size):
     return CenterCrop(output_size)(img)
+
+
+# -- functional color / geometry ops (reference: vision/transforms/
+# functional.py; HWC numpy convention, host-side preprocessing by design —
+# image decode/augment feeds the device pipeline, it doesn't run on it) -----
+def _as_float(img):
+    """-> (float32 array, is_uint8, value_range_hi).  uint8-ness (output
+    dtype) and value range (0..255 floats are common pre-ToTensor) are
+    tracked separately so float inputs never come back as uint8."""
+    arr = _to_np(img)
+    u8 = arr.dtype == np.uint8
+    hi = 255.0 if (u8 or arr.max() > 1.5) else 1.0
+    return arr.astype(np.float32), u8, hi
+
+
+def _restore(arr, u8, hi, like):
+    arr = np.clip(arr, 0, hi)
+    out = arr.astype(np.uint8) if u8 else arr.astype(np.float32)
+    return Tensor(out) if isinstance(like, Tensor) else out
+
+
+def adjust_brightness(img, brightness_factor):
+    arr, u8, hi = _as_float(img)
+    return _restore(arr * brightness_factor, u8, hi, img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr, u8, hi = _as_float(img)
+    gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+            + 0.114 * arr[..., 2])[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    return _restore(gray, u8, hi, img)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr, u8, hi = _as_float(img)
+    mean = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+            + 0.114 * arr[..., 2]).mean()
+    return _restore(mean + contrast_factor * (arr - mean), u8, hi, img)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr, u8, hi = _as_float(img)
+    gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+            + 0.114 * arr[..., 2])[..., None]
+    return _restore(gray + saturation_factor * (arr - gray), u8, hi, img)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) via RGB<->HSV."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr, u8, hi = _as_float(img)
+    x = arr / hi
+    mx = x.max(-1)
+    mn = x.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    h = np.where(mx == r, ((g - b) / diff) % 6,
+                 np.where(mx == g, (b - r) / diff + 2,
+                          (r - g) / diff + 4)) / 6.0
+    h = (h + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    v = mx
+    i = np.floor(h * 6).astype(np.int32) % 6
+    f = h * 6 - np.floor(h * 6)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    rgb = np.select(
+        [(i == k)[..., None] for k in range(6)],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return _restore(rgb * hi, u8, hi, img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _to_np(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    spec = [(pt, pb), (pl, pr), (0, 0)][:arr.ndim]
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if padding_mode == "constant" else {}
+    out = np.pad(arr, spec, mode=mode, **kw)
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def _inverse_warp(arr, minv, out_h=None, out_w=None, fill=0.0):
+    """Bilinear inverse warp of an HWC image with a 3x3 matrix mapping
+    OUTPUT pixel coords to input coords."""
+    H, W = arr.shape[0], arr.shape[1]
+    oh, ow = out_h or H, out_w or W
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float32),
+                         np.arange(ow, dtype=np.float32), indexing="ij")
+    ones = np.ones_like(xs)
+    src = minv @ np.stack([xs.ravel(), ys.ravel(), ones.ravel()])
+    sx = src[0] / src[2]
+    sy = src[1] / src[2]
+    x0 = np.floor(sx).astype(np.int64)
+    y0 = np.floor(sy).astype(np.int64)
+    wx = sx - x0
+    wy = sy - y0
+
+    def tap(xi, yi):
+        inb = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+        v = arr[np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)]
+        return np.where(inb[..., None] if arr.ndim == 3 else inb, v, fill)
+
+    def wgt(w):  # weights broadcast over the channel dim only for HWC
+        return w[:, None] if arr.ndim == 3 else w
+
+    out = (tap(x0, y0) * wgt((1 - wx) * (1 - wy))
+           + tap(x0 + 1, y0) * wgt(wx * (1 - wy))
+           + tap(x0, y0 + 1) * wgt((1 - wx) * wy)
+           + tap(x0 + 1, y0 + 1) * wgt(wx * wy))
+    return out.reshape(oh, ow, *arr.shape[2:])
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
+           fill=0):
+    arr, u8, hi = _as_float(img)
+    H, W = arr.shape[0], arr.shape[1]
+    # integer pixel grid: the geometric center is (W-1)/2 (a W/2 center
+    # shifts even-sized images half a pixel vs np.rot90/torchvision)
+    cx, cy = center if center is not None else ((W - 1) / 2.0,
+                                                (H - 1) / 2.0)
+    # positive angle = counter-clockwise (torchvision/paddle convention);
+    # with y-down image coords that is a negative math-angle rotation
+    a = np.deg2rad(-angle)
+    # inverse rotation (output -> input)
+    m = np.array([[np.cos(a), np.sin(a)], [-np.sin(a), np.cos(a)]])
+    if expand:
+        corners = np.array([[0, 0], [W, 0], [0, H], [W, H]]) - [cx, cy]
+        rot = corners @ np.array([[np.cos(a), -np.sin(a)],
+                                  [np.sin(a), np.cos(a)]]).T
+        ow = int(np.ceil(rot[:, 0].max() - rot[:, 0].min()))
+        oh = int(np.ceil(rot[:, 1].max() - rot[:, 1].min()))
+        ocx, ocy = (ow - 1) / 2.0, (oh - 1) / 2.0
+    else:
+        ow, oh, ocx, ocy = W, H, cx, cy
+    minv = np.eye(3)
+    minv[:2, :2] = m
+    minv[:2, 2] = [cx - m[0, 0] * ocx - m[0, 1] * ocy,
+                   cy - m[1, 0] * ocx - m[1, 1] * ocy]
+    return _restore(_inverse_warp(arr, minv, oh, ow, fill), u8, hi, img)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    arr, u8, hi = _as_float(img)
+    H, W = arr.shape[0], arr.shape[1]
+    cx, cy = center if center is not None else ((W - 1) / 2.0,
+                                                (H - 1) / 2.0)
+    a = np.deg2rad(-angle)  # ccw-positive, matching rotate()
+    sx, sy = [np.deg2rad(s) for s in (shear if isinstance(
+        shear, (list, tuple)) else (shear, 0.0))]
+    # forward affine (torchvision convention), then invert
+    m = np.array([
+        [scale * np.cos(a + sy) / np.cos(sy),
+         scale * (-np.cos(a + sy) * np.tan(sx) / np.cos(sy) - np.sin(a)),
+         0],
+        [scale * np.sin(a + sy) / np.cos(sy),
+         scale * (-np.sin(a + sy) * np.tan(sx) / np.cos(sy) + np.cos(a)),
+         0],
+        [0, 0, 1]])
+    m[0, 2] = translate[0] + cx - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = translate[1] + cy - m[1, 0] * cx - m[1, 1] * cy
+    return _restore(_inverse_warp(arr, np.linalg.inv(m), fill=fill), u8,
+                    hi, img)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Warp so startpoints map to endpoints (reference:
+    transforms/functional.py perspective; homography via least squares)."""
+    arr, u8, hi = _as_float(img)
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([sx, sy, 1, 0, 0, 0, -ex * sx, -ex * sy])
+        a.append([0, 0, 0, sx, sy, 1, -ey * sx, -ey * sy])
+        b.extend([ex, ey])
+    h8 = np.linalg.lstsq(np.asarray(a, np.float64),
+                         np.asarray(b, np.float64), rcond=None)[0]
+    hmat = np.append(h8, 1.0).reshape(3, 3)
+    return _restore(_inverse_warp(arr, np.linalg.inv(hmat), fill=fill),
+                    u8, hi, img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Zero/fill a region (reference: transforms/functional.py erase;
+    CHW tensors and HWC arrays both accepted)."""
+    if isinstance(img, Tensor):
+        arr = np.array(img.numpy(), copy=True)
+        arr[..., i:i + h, j:j + w] = v
+        if inplace:
+            import jax.numpy as jnp
+            img._data = jnp.asarray(arr)
+            return img
+        return Tensor(arr)
+    arr = np.array(_to_np(img), copy=True)
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+# -- transform classes -------------------------------------------------------
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + random.uniform(-self.value, self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + random.uniform(-self.value, self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding, self.fill = padding, fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (degrees if isinstance(degrees, (list, tuple))
+                        else (-degrees, degrees))
+        self.expand, self.center, self.fill = expand, center, fill
+
+    def _apply_image(self, img):
+        ang = random.uniform(*self.degrees)
+        return rotate(img, ang, expand=self.expand, center=self.center,
+                      fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (degrees if isinstance(degrees, (list, tuple))
+                        else (-degrees, degrees))
+        self.translate, self.scale_rng = translate, scale
+        self.shear, self.fill, self.center = shear, fill, center
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        H, W = arr.shape[0], arr.shape[1]
+        ang = random.uniform(*self.degrees)
+        tr = ((random.uniform(-self.translate[0], self.translate[0]) * W,
+               random.uniform(-self.translate[1], self.translate[1]) * H)
+              if self.translate else (0, 0))
+        sc = (random.uniform(*self.scale_rng) if self.scale_rng else 1.0)
+        if isinstance(self.shear, (list, tuple)):
+            sh = random.uniform(*self.shear)
+        elif self.shear:
+            sh = random.uniform(-self.shear, self.shear)
+        else:
+            sh = 0.0
+        return affine(img, ang, tr, sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.distortion_scale = prob, distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if random.random() > self.prob:
+            return img
+        arr = _to_np(img)
+        H, W = arr.shape[0], arr.shape[1]
+        d = self.distortion_scale
+
+        def jitter(x, y):
+            return (x + random.uniform(-d, d) * W / 2,
+                    y + random.uniform(-d, d) * H / 2)
+        start = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        end = [jitter(*p) for p in start]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        if random.random() > self.prob:
+            return img
+        arr = _to_np(img)
+        H, W = (arr.shape[-2], arr.shape[-1]) if isinstance(img, Tensor) \
+            else (arr.shape[0], arr.shape[1])
+        area = H * W * random.uniform(*self.scale)
+        ratio = random.uniform(*self.ratio)
+        h = min(H, max(1, int(round(np.sqrt(area * ratio)))))
+        w = min(W, max(1, int(round(np.sqrt(area / ratio)))))
+        i = random.randint(0, H - h)
+        j = random.randint(0, W - w)
+        return erase(img, i, j, h, w, self.value, self.inplace)

@@ -361,6 +361,20 @@ def test_geometric_accepts_tensor_probs():
     ("/root/reference/python/paddle/amp/__init__.py", "amp"),
     ("/root/reference/python/paddle/metric/__init__.py", "metric"),
     ("/root/reference/python/paddle/jit/__init__.py", "jit"),
+    ("/root/reference/python/paddle/distributed/__init__.py",
+     "distributed"),
+    ("/root/reference/python/paddle/incubate/__init__.py", "incubate"),
+    ("/root/reference/python/paddle/incubate/nn/__init__.py",
+     "incubate.nn"),
+    ("/root/reference/python/paddle/vision/transforms/__init__.py",
+     "vision.transforms"),
+    ("/root/reference/python/paddle/vision/ops.py", "vision.ops"),
+    ("/root/reference/python/paddle/vision/models/__init__.py",
+     "vision.models"),
+    ("/root/reference/python/paddle/text/__init__.py", "text"),
+    ("/root/reference/python/paddle/audio/__init__.py", "audio"),
+    ("/root/reference/python/paddle/distributed/fleet/__init__.py",
+     "distributed.fleet"),
 ])
 def test_nn_namespaces_fully_covered(ref_path, mod_name):
     src = open(ref_path).read()
@@ -440,3 +454,180 @@ class TestNamespaceGapFills:
     def test_jit_logging_knobs(self):
         paddle.jit.set_code_level(50)
         paddle.jit.set_verbosity(3)
+
+
+class TestBreadthBatch:
+    def test_audio_io_roundtrip(self, tmp_path):
+        sr = 16000
+        sig = np.sin(np.linspace(0, 100, 1600)).astype(np.float32)[None]
+        p = str(tmp_path / "t.wav")
+        paddle.audio.save(p, paddle.to_tensor(sig), sr)
+        back, sr2 = paddle.audio.load(p)
+        assert sr2 == sr
+        np.testing.assert_allclose(back.numpy(), sig, atol=1e-3)
+        assert paddle.audio.info(p).sample_rate == sr
+
+    def test_transforms_rotate_matches_rot90(self):
+        img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(
+            np.uint8)
+        T = paddle.vision.transforms
+        np.testing.assert_allclose(
+            T.rotate(img, 90).astype(float),
+            np.rot90(img, 1, (0, 1)).astype(float), atol=1.0)
+        np.testing.assert_allclose(
+            T.affine(img, 90, (0, 0), 1.0, 0.0).astype(float),
+            T.rotate(img, 90).astype(float), atol=1.0)
+
+    def test_transforms_hue_saturation_identity(self):
+        img = (np.random.RandomState(1).rand(6, 6, 3) * 255).astype(
+            np.uint8)
+        T = paddle.vision.transforms
+        np.testing.assert_allclose(
+            T.adjust_hue(img, 0.0).astype(float), img.astype(float),
+            atol=2.0)
+        np.testing.assert_allclose(
+            T.adjust_saturation(img, 1.0).astype(float), img.astype(float),
+            atol=1.0)
+
+    def test_matrix_nms_decays_overlaps(self):
+        # box 1 overlaps box 0 (iou ~0.67): its score decays but survives;
+        # box 2 is disjoint and keeps its score
+        boxes = np.array([[[0, 0, 10, 10], [2, 0, 12, 10],
+                           [20, 20, 30, 30]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)   # [N, C, M]; class 0 = bg
+        scores[0, 1] = [0.9, 0.8, 0.95]
+        out, num = paddle.vision.ops.matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.0, background_label=0)
+        o = out.numpy()
+        assert int(num.numpy()[0]) == 3
+        decayed = sorted(o[:, 1])[0]
+        assert decayed < 0.5                        # 0.8 * (1 - 0.67)
+        assert sorted(o[:, 1])[-1] == np.float32(0.95)  # disjoint untouched
+
+    def test_prior_box_shapes_and_range(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, var = paddle.vision.ops.prior_box(
+            feat, img, min_sizes=[8.0], aspect_ratios=[2.0], clip=True)
+        assert list(boxes.shape) == [4, 4, 2, 4]
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 1).all()
+
+    def test_incubate_lookahead_and_model_average(self):
+        lin = paddle.nn.Linear(3, 1)
+        opt = paddle.incubate.LookAhead(
+            paddle.optimizer.SGD(0.1, parameters=lin.parameters()), k=2)
+        x = paddle.to_tensor(np.ones((4, 3), np.float32))
+        for _ in range(4):
+            loss = (lin(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        ma = paddle.incubate.ModelAverage(parameters=lin.parameters())
+        w_now = lin.parameters()[0].numpy().copy()
+        ma.step()
+        ma.apply()
+        np.testing.assert_allclose(lin.parameters()[0].numpy(), w_now,
+                                   atol=1e-6)
+        ma.restore()
+
+    def test_softmax_mask_fuse_upper_triangle_is_causal(self):
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .rand(1, 1, 4, 4).astype(np.float32))
+        p = paddle.incubate.softmax_mask_fuse_upper_triangle(x).numpy()
+        assert np.allclose(np.triu(p[0, 0], k=1), 0.0, atol=1e-6)
+        np.testing.assert_allclose(p[0, 0].sum(-1), 1.0, rtol=1e-5)
+
+    def test_distributed_compat_objects(self):
+        from paddle_tpu import distributed as dist
+        assert dist.ParallelMode.DATA_PARALLEL == 0
+        assert dist.is_available()
+        e = dist.ProbabilityEntry(0.5)
+        assert "probability_entry" in e._to_attr()
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(2.0)
+        s = dist.Strategy()
+        assert s.pipeline["schedule_mode"] == "1F1B"
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=2)
+
+    def test_dist_model_trains(self):
+        from paddle_tpu import distributed as dist
+        lin = paddle.nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        dm = dist.DistModel(lin, loss=paddle.nn.MSELoss(), optimizer=opt)
+        x = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.rand(8, 2).astype(np.float32))
+        l0 = float(dm(x, y).numpy())
+        for _ in range(5):
+            l1 = float(dm(x, y).numpy())
+        assert l1 < l0
+        dm.eval()
+        assert list(dm(x).shape) == [8, 2]
+
+    def test_fleet_util_and_data_generator(self):
+        from paddle_tpu.distributed import fleet
+
+        class Gen(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def g():
+                    yield [("ids", [1, 2]), ("label", [0])]
+                return g
+        out = Gen().run_from_memory(["x"])
+        assert out == ["2 1 2 1 0\n"]
+        u = fleet.UtilBase()
+        assert u.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+
+
+class TestBreadthReviewFixes:
+    def test_rotate_grayscale_2d(self):
+        img = (np.random.RandomState(0).rand(8, 8) * 255).astype(np.uint8)
+        T = paddle.vision.transforms
+        r = T.rotate(img, 90)
+        np.testing.assert_allclose(r.astype(float),
+                                   np.rot90(img).astype(float), atol=1.0)
+
+    def test_float_255_range_stays_float(self):
+        T = paddle.vision.transforms
+        img = (np.random.RandomState(0).rand(4, 4, 3) * 255).astype(
+            np.float32)
+        out = T.adjust_brightness(img, 1.1)
+        assert out.dtype == np.float32
+        assert out.max() <= 255.0 + 1e-3
+
+    def test_matrix_nms_decay_never_boosts(self):
+        # iou(C,A)=big, iou(C,B)=small, iou(B,A)=big: the per-predecessor
+        # min must keep decay <= 1 (a global-max compensation boosts it)
+        boxes = np.array([[[0, 0, 10, 10], [4, 0, 14, 10],
+                           [5, 0, 15, 10]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.85, 0.8]
+        out, num = paddle.vision.ops.matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.0, background_label=0)
+        o = out.numpy()
+        orig = {0.9, 0.85, 0.8}
+        for row in o:
+            assert row[1] <= max(orig) + 1e-6
+        # every decayed score <= its original
+        assert sorted(o[:, 1])[-1] == np.float32(0.9)
+
+    def test_random_affine_scalar_shear_applies(self):
+        T = paddle.vision.transforms
+        img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(
+            np.uint8)
+        t = T.RandomAffine(degrees=0, shear=30)
+        outs = {t(img).tobytes() for _ in range(8)}
+        assert len(outs) > 1  # shear actually samples
+
+    def test_strategy_config_merges_sections(self):
+        from paddle_tpu import distributed as dist
+        s = dist.Strategy({"sharding": {"enable": True}})
+        assert s.sharding.enable is True
+        assert s.sharding["degree"] == 1  # merged, not replaced
+
+    def test_fleet_all_reduce_mode_validated(self):
+        from paddle_tpu.distributed import fleet
+        with pytest.raises(ValueError):
+            fleet.UtilBase().all_reduce(np.ones(2), mode="bogus")
