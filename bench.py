@@ -62,6 +62,16 @@ def _run_leg(cfg, batch, seq, iters, rounds):
 
 
 def main():
+    if os.environ.get("PTPU_BENCH_SMOKE") == "1":
+        # perf-contract smoke leg: asserts steady-state steps do zero
+        # host-side hydrate/bind work (see scripts/bench_smoke.py)
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        import bench_smoke
+        bench_smoke.run()
+        return
+
     import jax
 
     from paddle_tpu.models import GPTConfig

@@ -89,6 +89,8 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
     single = not isinstance(models, (list, tuple))
     model_list = [models] if single else list(models)
     if level == "O2":
+        from ..core.state import bump_param_version
+        bump_param_version()  # flush device-resident state, then cast
         target = "float16" if dtype in ("float16", "fp16") else "bfloat16"
         for m in model_list:
             for lay in m.sublayers(include_self=True):
@@ -214,16 +216,30 @@ class GradScaler:
         return Tensor._wrap(jnp.asarray(self._scale, jnp.float32))
 
     def set_init_loss_scaling(self, v):
+        from ..core.state import bump_param_version
+        bump_param_version()  # flush device-resident state, then overwrite
         self._scale = float(v)
 
+    def _sync_from_train_step(self):
+        src = self.__dict__.get("_train_step_owner")
+        step = src() if src is not None else None
+        if step is not None:
+            step.sync()
+
     def state_dict(self):
-        return {"scale": self._scale, "good_steps": self._good_steps,
-                "bad_steps": self._bad_steps}
+        # after _absorb the counters are device scalars; checkpoints want
+        # plain python numbers
+        self._sync_from_train_step()
+        return {"scale": float(self._scale),
+                "good_steps": int(self._good_steps),
+                "bad_steps": int(self._bad_steps)}
 
     def load_state_dict(self, state):
-        self._scale = state.get("scale", self._scale)
-        self._good_steps = state.get("good_steps", 0)
-        self._bad_steps = state.get("bad_steps", 0)
+        from ..core.state import bump_param_version
+        bump_param_version()  # flush device-resident state, then overwrite
+        self._scale = float(state.get("scale", self._scale))
+        self._good_steps = int(state.get("good_steps", 0))
+        self._bad_steps = int(state.get("bad_steps", 0))
 
 
 def is_bfloat16_supported(device=None):

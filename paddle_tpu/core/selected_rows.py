@@ -20,7 +20,11 @@ import numpy as np
 
 class SelectedRows:
     def __init__(self, rows, values, height):
-        self.rows = jnp.asarray(np.asarray(rows), jnp.int32)
+        import jax
+        if isinstance(rows, jax.core.Tracer):
+            self.rows = rows.astype(jnp.int32)
+        else:
+            self.rows = jnp.asarray(np.asarray(rows), jnp.int32)
         self.values = values if hasattr(values, "dtype") else jnp.asarray(
             values)
         self.height = int(height)
@@ -39,6 +43,18 @@ class SelectedRows:
         dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
                           self.values.dtype)
         return dense.at[self.rows].add(self.values)
+
+    def merge_rows(self):
+        """Accumulate duplicate rows into unique rows (reference:
+        phi/kernels/funcs/selected_rows_functor.h MergeAdd) — O(k·dim)
+        instead of densifying to O(V·dim).  Eager-only (host unique)."""
+        rows_np = np.asarray(self.rows)
+        uniq, inv = np.unique(rows_np, return_inverse=True)
+        if uniq.size == rows_np.size:
+            return self  # already unique
+        merged = jnp.zeros((uniq.size,) + tuple(self.values.shape[1:]),
+                           self.values.dtype).at[inv].add(self.values)
+        return SelectedRows(uniq, merged, self.height)
 
     def numpy(self):
         return np.asarray(self.to_dense())

@@ -26,6 +26,50 @@ class _State(threading.local):
 
 STATE = _State()
 
+# ---------------------------------------------------------------------------
+# Train-state mutation version + pre-mutation barrier.
+#
+# Device-resident train steps (jit.CompiledTrainStep) keep the flat
+# params/buffers/opt-state pytree from the previous step's OUTPUT and feed it
+# straight back in, skipping the O(num_params) Layer/Optimizer dict rebuilds.
+# They stay correct by watching this process-global counter: every official
+# host-side mutation path (Parameter.set_value, Layer.set_state_dict,
+# Layer.to(dtype), Optimizer.set_state_dict, amp.decorate, Tensor.zero_)
+# calls ``bump_param_version()`` BEFORE applying its write.  The call is a
+# barrier: it first flushes every live device-resident step back into the
+# python objects (so the write lands on post-step values, not stale ones),
+# then advances the version so those steps re-hydrate on their next call.
+# Raw ``t._data = ...`` writes are NOT tracked — use the official APIs or
+# call ``step.sync()`` / ``step.invalidate()`` explicitly.
+# ---------------------------------------------------------------------------
+_PARAM_VERSION = [0]
+_PARAM_SYNC_HOOKS: list = []  # weakref.WeakMethod -> CompiledTrainStep.sync
+
+
+def register_param_sync_hook(bound_sync_method):
+    """Register a device-state flush callback (held weakly) that the
+    mutation barrier invokes before any tracked host-side write."""
+    import weakref
+    _PARAM_SYNC_HOOKS.append(weakref.WeakMethod(bound_sync_method))
+
+
+def bump_param_version():
+    """Pre-mutation barrier: flush device-resident train state to host,
+    then advance the version so compiled steps re-hydrate next call."""
+    if _PARAM_SYNC_HOOKS:
+        live = []
+        for ref in _PARAM_SYNC_HOOKS:
+            cb = ref()
+            if cb is not None:
+                cb()
+                live.append(ref)
+        _PARAM_SYNC_HOOKS[:] = live
+    _PARAM_VERSION[0] += 1
+
+
+def param_version() -> int:
+    return _PARAM_VERSION[0]
+
 
 @contextmanager
 def no_grad_guard():

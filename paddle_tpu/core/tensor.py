@@ -25,7 +25,7 @@ import numpy as np
 from . import dtype as dtypes
 from .autograd import run_backward
 from .dispatch import apply_op
-from .state import no_grad_guard
+from .state import bump_param_version, no_grad_guard
 
 _tensor_counter = [0]
 
@@ -211,6 +211,8 @@ class Tensor:
     def zero_(self):
         """In-place fill with zeros (reference: paddle.Tensor.zero_ zeroes the
         tensor *data*, not the gradient)."""
+        if self.persistable:  # parameter mutated outside the compiled step
+            bump_param_version()
         self._data = jnp.zeros_like(self._data)
         return self
 
@@ -391,6 +393,7 @@ class Parameter(Tensor):
         self.trainable = trainable
 
     def set_value(self, value):
+        bump_param_version()  # flush device-resident state, then mutate
         value = value._data if isinstance(value, Tensor) else jnp.asarray(value)
         with no_grad_guard():
             self._data = value.astype(self._data.dtype)

@@ -20,6 +20,9 @@ from ...nn.clip import ClipGradByGlobalNorm
 class HybridParallelClipGrad:
     """reference: hybrid_parallel_optimizer.py:41."""
 
+    # delegates to ClipGradByGlobalNorm, which merges SelectedRows grads
+    _handles_selected_rows = True
+
     def __init__(self, clip, hcg=None):
         self._clip = clip
         self._hcg = hcg

@@ -33,14 +33,33 @@ def _is_layer(obj):
 
 # ---------------------------------------------------------------------------
 # State (de)hydration: Layer/Optimizer <-> pytree of jax arrays
+#
+# _HOST_SYNC_COUNTS tallies every hydrate/bind that runs as eager host work
+# (trace-time binds inside jax.jit are one-time compile cost and excluded),
+# so the perf contract of CompiledTrainStep ("zero per-parameter host work in
+# steady state") is checkable: scripts/bench_smoke.py snapshots it around
+# steady-state steps and asserts no movement.
 # ---------------------------------------------------------------------------
+_HOST_SYNC_COUNTS = {"layer_state": 0, "bind_layer_state": 0,
+                     "optimizer_state": 0, "bind_optimizer_state": 0}
+
+
+def host_sync_counts():
+    """Copy of the hydrate/bind call counters (see scripts/bench_smoke.py)."""
+    return dict(_HOST_SYNC_COUNTS)
+
+
 def layer_state(layer):
+    if STATE.tracing_depth == 0:
+        _HOST_SYNC_COUNTS["layer_state"] += 1
     params = {k: p._data for k, p in layer.named_parameters()}
     buffers = {k: b._data for k, b in layer.named_buffers()}
     return params, buffers
 
 
 def bind_layer_state(layer, params, buffers):
+    if STATE.tracing_depth == 0:
+        _HOST_SYNC_COUNTS["bind_layer_state"] += 1
     for k, p in layer.named_parameters():
         if k in params:
             p._data = params[k]
@@ -50,12 +69,16 @@ def bind_layer_state(layer, params, buffers):
 
 
 def optimizer_state(opt):
+    if STATE.tracing_depth == 0:
+        _HOST_SYNC_COUNTS["optimizer_state"] += 1
     accs = {name: dict(store) for name, store in opt._accumulators.items()}
     masters = dict(opt._master_weights)
     return {"acc": accs, "master": masters}
 
 
 def bind_optimizer_state(opt, state):
+    if STATE.tracing_depth == 0:
+        _HOST_SYNC_COUNTS["bind_optimizer_state"] += 1
     opt._accumulators = {name: dict(store)
                          for name, store in state["acc"].items()}
     opt._master_weights = dict(state["master"])
@@ -212,20 +235,90 @@ class CompiledTrainStep:
     training path (Program + StandaloneExecutor + fused optimizer ops,
     SURVEY §3.3) and the primary perf surface of the framework.
 
+    Device-resident state: the flat params/buffers/opt-state pytree lives on
+    device between steps — each call feeds the previous call's OUTPUT arrays
+    straight back in (donation makes the round trip zero-copy), so the
+    steady-state path does ZERO per-parameter python work: no Layer/Optimizer
+    dict rebuilds, no rebinds, no per-step lr upload (the device scalar is
+    cached against the scheduler's host float), no host RNG (the PRNG key is
+    split in-graph and carried).  The python ``model``/``optimizer`` objects
+    are therefore stale between steps; they re-converge via:
+
+      * ``step.sync()`` — explicit flush device -> host (cheap, pointer
+        rebinds only);
+      * automatically before ``model.state_dict()`` /
+        ``optimizer.state_dict()`` (checkpointing sees fresh values);
+      * automatically when an official mutation API runs
+        (``Parameter.set_value``, ``set_state_dict``, ``Layer.to(dtype)``,
+        ``amp.decorate``, ``Tensor.zero_`` ...): the mutation barrier in
+        ``core.state.bump_param_version`` flushes first, then the next call
+        re-hydrates from host so the mutation takes effect.
+
+    Raw ``tensor._data = ...`` pokes are NOT tracked — call
+    ``step.invalidate()`` after such surgery.
+
     With ``scaler`` (an enabled amp.GradScaler), fp16 dynamic loss scaling
     runs in-graph: scaled backward, traced found-inf, skipped update, scale
     adjustment — zero host round-trips (reference: amp/grad_scaler.py:619).
+    Donation stays full (params/buffers/opt-state) even with the scaler: the
+    skip-select reads the pre-step values INSIDE the program, so XLA aliasing
+    of inputs to outputs remains legal.
     """
 
     def __init__(self, model, loss_fn, optimizer, scaler=None, donate=True):
+        import weakref
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.scaler = scaler if (scaler is not None
                                  and scaler.is_enable()) else None
         self._jit = None
-        self._struct = None
         self._donate = donate
+        # (params, buffers, opt_state, sstate, rng_carry) — device resident
+        self._state = None
+        self._seen_version = -1
+        self._synced = True
+        self._lr_host = None
+        self._lr_dev = None
+        # state_dict() on the model/optimizer/scaler auto-syncs through this
+        model.__dict__["_train_step_owner"] = weakref.ref(self)
+        optimizer.__dict__["_train_step_owner"] = weakref.ref(self)
+        if self.scaler is not None:
+            self.scaler.__dict__["_train_step_owner"] = weakref.ref(self)
+        from ..core.state import register_param_sync_hook
+        register_param_sync_hook(self.sync)
+
+    # -- host <-> device state management -----------------------------------
+    def _hydrate(self):
+        """Read the python objects into the device-resident state tuple."""
+        from ..core.state import param_version
+        from ..tensor.random import _DEFAULT_GEN
+        params, buffers = layer_state(self.model)
+        opt_state = optimizer_state(self.optimizer)
+        sstate = (self.scaler._traced_state() if self.scaler is not None
+                  else {})
+        self._state = (params, buffers, opt_state, sstate,
+                       _DEFAULT_GEN.next_key())
+        self._seen_version = param_version()
+        self._synced = True
+
+    def sync(self):
+        """Flush the device-resident state back into the python
+        model/optimizer/scaler objects (pointer rebinds, no host transfer)."""
+        if self._state is None or self._synced:
+            return
+        params, buffers, opt_state, sstate, _ = self._state
+        bind_layer_state(self.model, params, buffers)
+        bind_optimizer_state(self.optimizer, opt_state)
+        if self.scaler is not None:
+            self.scaler._absorb(sstate)
+        self._synced = True
+
+    def invalidate(self):
+        """Drop the device-resident state; the next call re-hydrates from the
+        python objects.  Use after untracked ``t._data = ...`` surgery."""
+        self.sync()
+        self._state = None
 
     def _make_jit(self):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
@@ -233,14 +326,23 @@ class CompiledTrainStep:
 
         def step_fn(params, buffers, opt_state, lr, rng_key, sstate, args):
             from ..tensor import random as _rnd
-            bind_layer_state(model, params, buffers)
-            bind_optimizer_state(opt, opt_state)
+            # save the concrete host bindings: they are restored in the
+            # finally block so tracers never leak into Parameter._data /
+            # optimizer accumulators after the trace finishes
+            saved_params = [(p, p._data) for _, p in model.named_parameters()]
+            saved_buffers = [(b, b._data) for _, b in model.named_buffers()]
+            saved_accs = opt._accumulators
+            saved_masters = opt._master_weights
             prev_lr = opt._learning_rate
+            prev_step_count = opt._step_count
             prev_grad_mode = STATE.grad_enabled
-            opt._learning_rate = lr
-            _rnd._TRACE_CHAIN[0] = _rnd._TraceKeyChain(rng_key)
+            use_key, carry_key = jax.random.split(rng_key)
+            _rnd._TRACE_CHAIN[0] = _rnd._TraceKeyChain(use_key)
             STATE.tracing_depth += 1
             try:
+                bind_layer_state(model, params, buffers)
+                bind_optimizer_state(opt, opt_state)
+                opt._learning_rate = lr
                 wargs = jax.tree_util.tree_map(
                     lambda x: Tensor._wrap(x) if isinstance(
                         x, (jax.Array, jax.core.Tracer)) else x, args)
@@ -253,52 +355,69 @@ class CompiledTrainStep:
                     loss.backward()
                 opt.step()
                 opt.clear_grad()
+                new_params = {k: p._data for k, p in model.named_parameters()}
+                new_buffers = {k: b._data for k, b in model.named_buffers()}
+                new_opt = optimizer_state(opt)
+                if scaler is not None:
+                    new_params = _skip_select(found, params, new_params)
+                    new_opt = _skip_select(found, opt_state, new_opt)
+                    sstate = scaler._traced_update(sstate, found)
+                loss_data = loss._data
             finally:
                 STATE.tracing_depth -= 1
                 _rnd._TRACE_CHAIN[0] = None
                 opt._learning_rate = prev_lr
+                # the host step counter is owned by __call__ (one bump per
+                # step); the trace-time opt.step() bump must not stick
+                opt._step_count = prev_step_count
                 STATE.grad_enabled = prev_grad_mode
-            new_params = {k: p._data for k, p in model.named_parameters()}
-            new_buffers = {k: b._data for k, b in model.named_buffers()}
-            new_opt = optimizer_state(opt)
-            if scaler is not None:
-                new_params = _skip_select(found, params, new_params)
-                new_opt = _skip_select(found, opt_state, new_opt)
-                sstate = scaler._traced_update(sstate, found)
-            return loss._data, new_params, new_buffers, new_opt, sstate
+                for p, d in saved_params:
+                    p._data = d
+                    p.grad = None
+                for b, d in saved_buffers:
+                    b._data = d
+                opt._accumulators = saved_accs
+                opt._master_weights = saved_masters
+            return (loss_data, new_params, new_buffers, new_opt, sstate,
+                    carry_key)
 
         donate = ()
         if self._donate:
-            # with a scaler the pre-step params/opt-state feed the skip
-            # select, so only buffers are donatable
-            donate = (1,) if scaler is not None else (0, 1, 2)
+            # full donation including the scaler path: _skip_select consumes
+            # the pre-step values inside the program, so aliasing params/
+            # buffers/opt-state buffers to the outputs is still legal
+            donate = (0, 1, 2)
         return jax.jit(step_fn, donate_argnums=donate)
 
     def __call__(self, *args):
-        params, buffers = layer_state(self.model)
-        opt_state = optimizer_state(self.optimizer)
-        struct = jax.tree_util.tree_structure(opt_state)
-        if self._jit is None or struct != self._struct:
+        from ..core.state import param_version
+        hydrated = False
+        if self._state is None or param_version() != self._seen_version:
+            self._hydrate()
+            hydrated = True
+        if self._jit is None:
             self._jit = self._make_jit()
-            self._struct = struct
         args_data = jax.tree_util.tree_map(
             lambda x: x._data if isinstance(x, Tensor) else x, args,
             is_leaf=lambda x: isinstance(x, Tensor))
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        lr_val = self.optimizer.get_lr()
+        if self._lr_dev is None or lr_val != self._lr_host:
+            self._lr_host = lr_val
+            self._lr_dev = jnp.asarray(lr_val, jnp.float32)
+        params, buffers, opt_state, sstate, rng_key = self._state
+        (loss, new_params, new_buffers, new_opt, new_sstate,
+         new_rng) = self._jit(params, buffers, opt_state, self._lr_dev,
+                              rng_key, sstate, args_data)
+        # bump AFTER the call: at trace time opt.step() does its own bump, so
+        # t-based rules (NAdam/RAdam) see the same count an eager step would
         self.optimizer._step_count += 1
-        from ..tensor.random import _DEFAULT_GEN
-        rng_key = _DEFAULT_GEN.next_key()
-        sstate = (self.scaler._traced_state() if self.scaler is not None
-                  else {})
-        loss, new_params, new_buffers, new_opt, new_sstate = self._jit(
-            params, buffers, opt_state, lr, rng_key, sstate, args_data)
-        bind_layer_state(self.model, new_params, new_buffers)
-        bind_optimizer_state(self.optimizer, new_opt)
-        if self.scaler is not None:
-            self.scaler._absorb(new_sstate)
-        if isinstance(self.optimizer._learning_rate, object) and hasattr(
-                self.optimizer._learning_rate, "step"):
-            pass  # scheduler stepped by user (paddle semantics)
+        self._state = (new_params, new_buffers, new_opt, new_sstate, new_rng)
+        self._synced = False
+        if hydrated:
+            # first call after (re)hydration: keep the python objects fresh
+            # so "step once, then inspect" retains eager semantics; the
+            # steady-state path skips this entirely
+            self.sync()
         from ..distributed.elastic import heartbeat
         heartbeat()  # no-op unless under the elastic launcher
         return Tensor._wrap(loss)

@@ -48,18 +48,42 @@ class ClipGradByNorm(ClipGradBase):
 class ClipGradByGlobalNorm(ClipGradBase):
     """reference: nn/clip.py ClipGradByGlobalNorm; distributed variant
     allreduces the squared norms across mesh axes
-    (fleet hybrid_parallel_optimizer.py:41)."""
+    (fleet hybrid_parallel_optimizer.py:41).
+
+    SelectedRows gradients participate like the reference: duplicate rows are
+    merged first (MergeAdd), their squared values join the global norm, and
+    the clip coefficient scales the sparse values in place — no densify."""
+
+    # consumed by Optimizer.step: sparse grads may be routed through us
+    _handles_selected_rows = True
 
     def __init__(self, clip_norm, group_name="default_group",
                  auto_skip_clip=False):
         self.clip_norm = clip_norm
 
     def __call__(self, params_grads):
+        from ..core.selected_rows import SelectedRows
+        merged = {}
         sq = []
         for p, g in params_grads:
             if g is None or getattr(p, "need_clip", True) is False:
                 continue
-            sq.append(jnp.sum(jnp.square(g._data.astype(jnp.float32))))
+            if isinstance(g, SelectedRows):
+                import jax
+                if isinstance(g.rows, jax.core.Tracer):
+                    # traced rows can't host-unique; the dense twin gives the
+                    # same merged norm (duplicates accumulate) and stays
+                    # traceable inside compiled train steps
+                    merged[id(g)] = g
+                    sq.append(jnp.sum(jnp.square(
+                        g.to_dense().astype(jnp.float32))))
+                else:
+                    m = g.merge_rows()
+                    merged[id(g)] = m
+                    sq.append(jnp.sum(jnp.square(
+                        m.values.astype(jnp.float32))))
+            else:
+                sq.append(jnp.sum(jnp.square(g._data.astype(jnp.float32))))
         if not sq:
             return params_grads
         global_norm = jnp.sqrt(sum(sq[1:], sq[0]))
@@ -69,7 +93,14 @@ class ClipGradByGlobalNorm(ClipGradBase):
             if g is None or getattr(p, "need_clip", True) is False:
                 out.append((p, g))
                 continue
-            out.append((p, Tensor._wrap((g._data * scale).astype(g._data.dtype))))
+            if isinstance(g, SelectedRows):
+                m = merged[id(g)]
+                vals = (m.values.astype(jnp.float32) * scale).astype(
+                    m.values.dtype)
+                out.append((p, SelectedRows(m.rows, vals, m.height)))
+            else:
+                out.append((p, Tensor._wrap(
+                    (g._data * scale).astype(g._data.dtype))))
         return out
 
 

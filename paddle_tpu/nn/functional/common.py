@@ -40,17 +40,21 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
     if p == 1:
         return apply_op("dropout", lambda v: jnp.zeros_like(v), _t(x))
     x = _t(x)
-    shape = list(x._data.shape)
-    if axis is not None:
-        axes = [axis] if isinstance(axis, int) else list(axis)
-        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
-    keep = jax.random.bernoulli(_next_key(), 1.0 - p, tuple(shape))
+    # the key rides as an op ARGUMENT (not a closure) so static Programs
+    # record it as a per-run rng leaf: Executor.run folds a fresh root key in
+    # per replay instead of freezing the dispatch-time mask
+    key = Tensor._wrap(_next_key(recording_ok=True))
 
-    def fn(v):
+    def fn(v, k):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
         if mode == "upscale_in_train":
             return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
         return jnp.where(keep, v, 0.0).astype(v.dtype)
-    return apply_op("dropout", fn, x)
+    return apply_op("dropout", fn, x, key)
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -71,13 +75,14 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
-    keep = jax.random.bernoulli(_next_key(), 1.0 - p, x._data.shape)
     a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
     b = -a * alpha_p * p
+    key = Tensor._wrap(_next_key(recording_ok=True))
 
-    def fn(v):
+    def fn(v, k):
+        keep = jax.random.bernoulli(k, 1.0 - p, v.shape)
         return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
-    return apply_op("alpha_dropout", fn, x)
+    return apply_op("alpha_dropout", fn, x, key)
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):

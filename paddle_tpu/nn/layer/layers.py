@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core import dtype as dtypes
-from ...core.state import no_grad_guard
+from ...core.state import bump_param_version, no_grad_guard
 from ...core.tensor import Parameter, Tensor
 
 
@@ -42,6 +42,7 @@ class Layer:
         if isinstance(value, Parameter):
             if params is None:
                 raise RuntimeError("call super().__init__() first")
+            bump_param_version()  # flush device state before the rebind
             params[name] = value
             for d in (layers, buffers):
                 if d is not None:
@@ -59,6 +60,7 @@ class Layer:
             object.__setattr__(self, name, value)
         elif buffers is not None and name in buffers:
             if isinstance(value, Tensor) or value is None:
+                bump_param_version()  # flush device state before the rebind
                 buffers[name] = value
             object.__setattr__(self, name, value)
         else:
@@ -223,8 +225,18 @@ class Layer:
         return main + ")"
 
     # -- state dict ----------------------------------------------------------
+    def _sync_from_train_step(self):
+        """If a device-resident train step (jit.CompiledTrainStep) owns this
+        layer's live state, pull it back into the Parameter/buffer objects so
+        host-side reads (state_dict, checkpointing) see post-step values."""
+        src = self.__dict__.get("_train_step_owner")
+        step = src() if src is not None else None
+        if step is not None:
+            step.sync()
+
     def state_dict(self, destination=None, include_sublayers=True,
                    structured_name_prefix="", use_hook=True):
+        self._sync_from_train_step()
         dest = destination if destination is not None else collections.OrderedDict()
         for name, p in self.named_parameters():
             dest[structured_name_prefix + name] = p
@@ -236,6 +248,7 @@ class Layer:
         return dest
 
     def set_state_dict(self, state_dict, use_structured_name=True):
+        bump_param_version()  # flush device state, then load on top of it
         missing, unexpected = [], []
         own = dict(self.named_parameters())
         own.update(dict(self.named_buffers()))
@@ -262,6 +275,7 @@ class Layer:
     # -- dtype / device ------------------------------------------------------
     def to(self, device=None, dtype=None, blocking=None):
         if dtype is not None:
+            bump_param_version()  # flush device state, then cast on top
             dt = dtypes.convert_dtype(dtype)
             for p in self.parameters():
                 p._data = p._data.astype(dt)

@@ -109,15 +109,24 @@ class Optimizer:
         with no_grad_guard():
             pg = self._collect_params_grads()
             if self._grad_clip is not None:
-                # SelectedRows grads bypass clipping (the reference's
-                # ClipGradByGlobalNorm squares sparse grads via their own
-                # merged path; here sparse params are embeddings, which
-                # hybrid recipes exclude from the clip group anyway)
-                dense = [(p, g) for p, g in pg
-                         if not isinstance(g, SelectedRows)]
-                sparse = [(p, g) for p, g in pg
-                          if isinstance(g, SelectedRows)]
-                pg = list(self._grad_clip(dense)) + sparse
+                if getattr(self._grad_clip, "_handles_selected_rows", False):
+                    # ClipGradByGlobalNorm merges SelectedRows rows into the
+                    # global norm and scales their values (reference:
+                    # nn/clip.py merge_selected_rows path)
+                    pg = list(self._grad_clip(pg))
+                else:
+                    dense = [(p, g) for p, g in pg
+                             if not isinstance(g, SelectedRows)]
+                    sparse = [(p, g) for p, g in pg
+                              if isinstance(g, SelectedRows)]
+                    if sparse:
+                        import warnings
+                        warnings.warn(
+                            f"{type(self._grad_clip).__name__} does not "
+                            "support SelectedRows gradients; "
+                            f"{len(sparse)} sparse grad(s) bypass clipping",
+                            RuntimeWarning, stacklevel=2)
+                    pg = list(self._grad_clip(dense)) + sparse
             self._step_count += 1
             for p, g in pg:
                 if isinstance(g, SelectedRows):
@@ -156,7 +165,16 @@ class Optimizer:
         self.clear_grad()
 
     # -- state dict ----------------------------------------------------------
+    def _sync_from_train_step(self):
+        """Pull device-resident accumulators/master-weights back from an
+        owning jit.CompiledTrainStep before host-side reads."""
+        src = self.__dict__.get("_train_step_owner")
+        step = src() if src is not None else None
+        if step is not None:
+            step.sync()
+
     def state_dict(self):
+        self._sync_from_train_step()
         names = {id(p): (p.name or f"param_{i}")
                  for i, p in enumerate(self._parameter_list or [])}
         out = {"master_weights": {}, "LR_Scheduler": {}, "accumulators": {},
@@ -172,6 +190,8 @@ class Optimizer:
         return out
 
     def set_state_dict(self, state):
+        from ..core.state import bump_param_version
+        bump_param_version()  # invalidate device-resident train state
         names = {(p.name or f"param_{i}"): p
                  for i, p in enumerate(self._parameter_list or [])}
         self._step_count = state.get("step", 0)

@@ -81,11 +81,25 @@ class Program:
         self._externals[vid] = t  # parameter/constant input: resolved live
         return vid
 
+    @staticmethod
+    def _is_rng_key(t):
+        try:
+            return jax.dtypes.issubdtype(t._data.dtype, jax.dtypes.prng_key)
+        except (AttributeError, TypeError):
+            return False
+
     def _record(self, name, fn, treedef, leaves, kwargs, outputs):
         leaf_keys = []
         for leaf in leaves:
             if isinstance(leaf, Tensor):
-                leaf_keys.append(("var", self._vid_of(leaf)))
+                if self._is_rng_key(leaf):
+                    # PRNG key argument (e.g. functional dropout): recorded
+                    # as a per-run rng leaf — replay folds the run's root key
+                    # with this slot id, so every Executor.run re-randomizes
+                    # instead of replaying the dispatch-time sample
+                    leaf_keys.append(("rng", next(self._next_vid)))
+                else:
+                    leaf_keys.append(("var", self._vid_of(leaf)))
             else:
                 leaf_keys.append(("const", leaf))
         outs = outputs if isinstance(outputs, tuple) else (outputs,)
@@ -109,22 +123,31 @@ class Program:
         return t
 
     # -- replay -------------------------------------------------------------
-    def _run_nodes(self, env, override_vid=None, override_val=None):
-        """Replay the op list.  With an override, the given vid takes
-        ``override_val`` INSTEAD of its producer's output (and instead of
-        its env0 entry), which is what differentiating w.r.t. an
-        intermediate variable means: downstream consumers see the override,
-        the producer's value for it is discarded."""
-        if override_vid is not None:
-            env[override_vid] = override_val
+    def _run_nodes(self, env, overrides=None, rng_root=None):
+        """Replay the op list.  ``overrides`` maps vids to values that take
+        the place of their producer's output (and of their env0 entry) —
+        which is what differentiating w.r.t. those variables means:
+        downstream consumers see the overrides, the producers' values are
+        discarded.  ``rng_root`` is the per-run PRNG root: each ("rng", n)
+        leaf resolves to fold_in(rng_root, n)."""
+        if overrides:
+            env.update(overrides)
         for node in self._nodes:
-            datas = [env[k] if kind == "var" else k
-                     for kind, k in node.leaf_keys]
+            datas = []
+            for kind, k in node.leaf_keys:
+                if kind == "var":
+                    datas.append(env[k])
+                elif kind == "rng":
+                    root = rng_root if rng_root is not None \
+                        else jax.random.key(0)
+                    datas.append(jax.random.fold_in(root, k))
+                else:
+                    datas.append(k)
             rebuilt = jax.tree_util.tree_unflatten(node.treedef, datas)
             out = node.fn(*rebuilt, **node.kwargs)
             outs = out if isinstance(out, tuple) else (out,)
             for vid, o in zip(node.out_keys, outs):
-                if vid != override_vid:
+                if not overrides or vid not in overrides:
                     env[vid] = o
         return env
 
@@ -319,30 +342,44 @@ class Executor:
                tuple(fetch_spec))
         compiled = program._compile_cache.get(key)
         if compiled is None:
-            def replay(feeds, exts):
+            # group grad fetches by target set: ONE jax.grad over a tuple of
+            # wrt values per group, so P requested grads cost 1 + G forward
+            # traces (G = distinct target sets, usually 1) instead of 1 + P
+            grad_groups = {}
+            for i, (kind, a, b) in enumerate(fetch_spec):
+                if kind == "grad":
+                    grad_groups.setdefault(a, []).append((i, b))
+
+            def replay(feeds, exts, rng_root):
                 env0 = dict(zip(feed_vids, feeds))
                 env0.update(zip(ext_vids, exts))
-                env = program._run_nodes(dict(env0))
-                results = []
-                for kind, a, b in fetch_spec:
+                env = program._run_nodes(dict(env0), rng_root=rng_root)
+                results = [None] * len(fetch_spec)
+                for i, (kind, a, b) in enumerate(fetch_spec):
                     if kind == "val":
-                        results.append(env[a])
-                        continue
+                        results[i] = env[a]
+                for t_vids, wrts in grad_groups.items():
+                    uniq = list(dict.fromkeys(b for _, b in wrts))
 
-                    def scalar_target(wval, _ts=a, _b=b):
-                        e = program._run_nodes(dict(env0), override_vid=_b,
-                                               override_val=wval)
+                    def scalar_target(wvals, _ts=t_vids, _uniq=tuple(uniq)):
+                        e = program._run_nodes(
+                            dict(env0), overrides=dict(zip(_uniq, wvals)),
+                            rng_root=rng_root)
                         return sum(jnp.sum(e[t]) for t in _ts)
-                    # differentiate at the variable's actual value — for
+                    # differentiate at the variables' actual values — for
                     # feeds/externals that's env0, for intermediates the
                     # forward pass's produced value
-                    at = env0.get(b, env.get(b))
-                    results.append(jax.grad(scalar_target)(at))
+                    ats = tuple(env0.get(b, env.get(b)) for b in uniq)
+                    grads = jax.grad(scalar_target)(ats)
+                    gmap = dict(zip(uniq, grads))
+                    for i, b in wrts:
+                        results[i] = gmap[b]
                 return results
 
             compiled = jax.jit(replay)
             program._compile_cache[key] = compiled
-        outs = compiled(feed_vals, ext_vals)
+        from ..tensor.random import _DEFAULT_GEN
+        outs = compiled(feed_vals, ext_vals, _DEFAULT_GEN.next_key())
         return [np.asarray(o) for o in outs]
 
     def close(self):

@@ -81,9 +81,27 @@ class _TraceKeyChain:
 _TRACE_CHAIN = [None]
 
 
-def _next_key():
+def _next_key(recording_ok=False):
+    """Draw the next PRNG key.
+
+    ``recording_ok=True`` marks callers that thread the key INTO the op as an
+    argument (e.g. functional dropout), so a recorded static Program replays
+    them with fresh per-run keys.  All other callers sample at dispatch time:
+    under ``program_guard`` that sample is frozen into the Program and every
+    ``Executor.run`` replays the identical values — warn so the silent
+    determinism is at least visible."""
     if _TRACE_CHAIN[0] is not None:
         return _TRACE_CHAIN[0].next()
+    if not recording_ok:
+        from ..core.state import STATE
+        if STATE.recording_program is not None:
+            import warnings
+            warnings.warn(
+                "dispatch-time randomness recorded under program_guard: the "
+                "sampled values are frozen into the Program and will replay "
+                "identically on every Executor.run (only key-threaded ops "
+                "like nn.functional.dropout re-randomize per run)",
+                RuntimeWarning, stacklevel=3)
     return _DEFAULT_GEN.next_key()
 
 

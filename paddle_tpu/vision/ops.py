@@ -283,7 +283,19 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     x: [N, mask_num*(5+C), H, W]; gt_box: [N, B, 4] (cx,cy,w,h, normalised);
     gt_label: [N, B] int; anchors: flat [a0w,a0h,a1w,...] in pixels.
     """
-    xd = np.asarray(x._data) if isinstance(x, Tensor) else np.asarray(x)
+    from ..core.state import STATE
+    if STATE.tracing_depth > 0 or any(
+            isinstance(t._data, jax.core.Tracer)
+            for t in (x, gt_box, gt_label, gt_score)
+            if isinstance(t, Tensor)):
+        raise RuntimeError(
+            "yolo_loss is eager-only: its target assignment inspects ground "
+            "truth boxes on the host and cannot run under jit/to_static — "
+            "compute this loss outside the compiled region (or precompute "
+            "the targets)")
+    # shape comes from metadata — x itself never leaves the device
+    N, _, H, W = (tuple(x.shape) if isinstance(x, Tensor)
+                  else np.asarray(x).shape)
     gb = np.asarray(gt_box._data if isinstance(gt_box, Tensor) else gt_box,
                     np.float32)
     gl = np.asarray(gt_label._data if isinstance(gt_label, Tensor)
@@ -291,7 +303,6 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     gs = (np.asarray(gt_score._data if isinstance(gt_score, Tensor)
                      else gt_score, np.float32)
           if gt_score is not None else np.ones(gl.shape, np.float32))
-    N, _, H, W = xd.shape
     an = np.asarray(anchors, np.float32).reshape(-1, 2)
     mask = list(anchor_mask)
     A = len(mask)

@@ -1,0 +1,58 @@
+"""Perf-contract smoke: 3 steps of a tiny GPT on CPU.
+
+Steps 2-3 (steady state) must do ZERO host-side hydrate/bind work — the
+device-resident contract of jit.CompiledTrainStep, watched through the
+jit.host_sync_counts() counters.  Prints one JSON line; raises on violation.
+
+Run directly (``python scripts/bench_smoke.py``), via ``PTPU_BENCH_SMOKE=1
+python bench.py``, or through tests/test_train_step_state.py (tier-1).
+"""
+
+import json
+import os
+
+
+def run():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.jit as pjit
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                    max_seq_len=64, use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    ids = paddle.randint(0, cfg.vocab_size, [2, 64])
+    labels = paddle.randint(0, cfg.vocab_size, [2, 64])
+
+    def loss_fn(m, x, l):
+        return crit(m(x), l)
+
+    step = pjit.CompiledTrainStep(model, loss_fn, opt)
+    losses = [float(step(ids, labels).numpy())]  # step 1: hydrate + compile
+    before = pjit.host_sync_counts()
+    losses.append(float(step(ids, labels).numpy()))  # step 2 (retrace only)
+    losses.append(float(step(ids, labels).numpy()))  # step 3 (cached)
+    after = pjit.host_sync_counts()
+    delta = {k: after[k] - before[k] for k in after}
+
+    result = {"metric": "steady_state_host_syncs",
+              "value": sum(delta.values()),
+              "unit": "calls/2 steps",
+              "delta": delta,
+              "losses": [round(l, 6) for l in losses]}
+    print(json.dumps(result))
+    if sum(delta.values()) != 0:
+        raise AssertionError(
+            f"steady-state steps did host hydrate/bind work: {delta}")
+    if not all(np.isfinite(l) for l in losses):
+        raise AssertionError(f"non-finite loss in smoke run: {losses}")
+    return result
+
+
+if __name__ == "__main__":
+    run()

@@ -34,6 +34,7 @@ class TestTraceableScaler:
         assert int(scaler._good_steps) == 1
         assert float(scaler._scale) == 1024.0
         step(x)
+        step.sync()  # state is device-resident between steps
         # second good step hits incr_every_n_steps=2 -> scale doubles
         assert float(scaler._scale) == 2048.0
         assert int(scaler._good_steps) == 0
@@ -53,6 +54,7 @@ class TestTraceableScaler:
                     opt._accumulators.get("moment1", {}).items()}
         xinf = paddle.to_tensor(np.full((2, 4), np.inf, np.float32))
         step(xinf)
+        step.sync()  # state is device-resident between steps
         # update skipped: params and moments unchanged, scale halved
         assert np.allclose(np_t(net.weight), w_before)
         for k, v in opt._accumulators.get("moment1", {}).items():
@@ -62,6 +64,7 @@ class TestTraceableScaler:
         # recovery: a finite batch trains again
         l = float(step(x).numpy())
         assert np.isfinite(l)
+        step.sync()
         assert not np.allclose(np_t(net.weight), w_before)
 
 
