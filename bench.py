@@ -40,10 +40,16 @@ def _run_leg(cfg, batch, seq, iters, rounds):
         return crit(m(x), l)
 
     step = CompiledTrainStep(model, loss_fn, opt)
-    # warmup / compile (2 structures: empty accs then full)
-    step(ids, labels)
+    # warmup / compile (2 structures: empty accs then full), timed per phase:
+    # compile_s covers hydrate + both traces + XLA compiles; first_step_s is
+    # the first fully-cached dispatch; steady_step_s is the measured median.
+    t0 = time.perf_counter()
     step(ids, labels)
     step(ids, labels).numpy()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    step(ids, labels).numpy()
+    first_step_s = time.perf_counter() - t0
 
     rates = []
     for _ in range(rounds):
@@ -57,8 +63,11 @@ def _run_leg(cfg, batch, seq, iters, rounds):
     spread = (float(np.max(rates) - np.min(rates)) / tokens_per_sec
               if len(rates) > 1 else 0.0)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    phases = {"compile_s": round(compile_s, 4),
+              "first_step_s": round(first_step_s, 4),
+              "steady_step_s": round(batch * seq / tokens_per_sec, 6)}
     del step, model, opt  # free HBM before the next leg
-    return tokens_per_sec, spread, n_params
+    return tokens_per_sec, spread, n_params, phases
 
 
 def main():
@@ -83,11 +92,12 @@ def main():
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=128,
                         use_flash_attention=False)
-        tps, spread, _ = _run_leg(cfg, 2, 128, 3, 1)
+        tps, spread, _, phases = _run_leg(cfg, 2, 128, 3, 1)
         print(json.dumps({"metric": "gpt_tiny_cpu_tokens_per_sec",
                           "value": round(tps, 2), "unit": "tokens/s",
                           "vs_baseline": 0.0,
-                          "spread_frac": round(spread, 4)}))
+                          "spread_frac": round(spread, 4),
+                          "phases": phases}))
         return
 
     which = os.environ.get("PTPU_BENCH", "all")
@@ -101,19 +111,21 @@ def main():
                                   recompute="selective_lean")
         # rounds=4: the first post-compile round can run ~3% cold (seen in
         # r5 combined runs); the median over 4 shakes it off
-        tps, spread, n = _run_leg(cfg, 8, 1024, 10, 4)
+        tps, spread, n, phases = _run_leg(cfg, 8, 1024, 10, 4)
         legs["gpt760m"] = {"tokens_per_sec": round(tps, 2),
                            "mfu": round(tps * 6 * n / peak, 4),
-                           "spread_frac": round(spread, 4)}
+                           "spread_frac": round(spread, 4),
+                           "phases": phases}
     if which in ("all", "125m"):
         cfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
                                   dtype="bfloat16",
                                   use_flash_attention=True,
                                   recompute="selective")
-        tps, spread, n = _run_leg(cfg, 16, 1024, 15, 3)
+        tps, spread, n, phases = _run_leg(cfg, 16, 1024, 15, 3)
         legs["gpt125m"] = {"tokens_per_sec": round(tps, 2),
                            "mfu": round(tps * 6 * n / peak, 4),
-                           "spread_frac": round(spread, 4)}
+                           "spread_frac": round(spread, 4),
+                           "phases": phases}
 
     flag = "gpt760m" if "gpt760m" in legs else "gpt125m"
     print(json.dumps({

@@ -14,6 +14,17 @@ import os
 from typing import Any
 
 _REGISTRY: dict[str, dict[str, Any]] = {}
+_OBSERVERS: dict[str, list] = {}
+
+
+def register_flag_observer(name: str, fn, call_now: bool = True):
+    """Invoke ``fn(value)`` whenever ``name`` changes via ``set_flags`` (and
+    once at registration so env-seeded values propagate).  Lets hot paths
+    cache a flag in a local instead of a registry lookup per event — the
+    host tracer keys its fast no-op check on this."""
+    _OBSERVERS.setdefault(name, []).append(fn)
+    if call_now and name in _REGISTRY:
+        fn(_REGISTRY[name]["value"])
 
 
 def define_flag(name: str, default, help_str: str = ""):
@@ -37,6 +48,8 @@ def set_flags(flags: dict):
         if k not in _REGISTRY:
             raise KeyError(f"unknown flag {k!r}; known: {sorted(_REGISTRY)}")
         _REGISTRY[k]["value"] = v
+        for fn in _OBSERVERS.get(k, ()):
+            fn(v)
 
 
 def get_flags(names):
@@ -66,3 +79,7 @@ define_flag("FLAGS_tpu_matmul_precision", "default",
             "default|high|highest -> jax.lax precision for matmul ops")
 define_flag("FLAGS_eager_op_jit", False,
             "route eager op execution through a per-op jit cache")
+define_flag("FLAGS_host_trace_level", 1,
+            "host tracer verbosity (reference: FLAGS_host_trace_level, "
+            "host_tracer.cc): 0 disables span recording entirely; 1 records "
+            "framework phase spans; 2 adds high-frequency spans")

@@ -15,6 +15,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...profiler import counters as _counters
+from ...profiler import host_tracer as _tracer
 from ..env import get_mesh, get_world_size
 
 
@@ -364,6 +366,32 @@ def barrier(group=None):
 def stream_all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
                       use_calc_stream=False):
     return all_reduce(tensor, op, group, sync_op)
+
+
+# ---------------------------------------------------------------------------
+# Observability: every eager collective bumps dist.collectives + dist.<op>
+# in profiler.counters and opens a host-tracer span.  (stream_all_reduce /
+# isend / irecv delegate to the wrapped primitives, so each logical
+# collective is counted exactly once.)
+# ---------------------------------------------------------------------------
+def _instrumented(fn):
+    import functools
+    cname = "dist." + fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        _counters.inc("dist.collectives")
+        _counters.inc(cname)
+        with _tracer.span(cname):
+            return fn(*args, **kwargs)
+    return wrapper
+
+
+for _n in ("all_reduce", "all_gather", "all_gather_object", "broadcast",
+           "reduce", "reduce_scatter", "scatter", "all_to_all", "send",
+           "recv", "barrier"):
+    globals()[_n] = _instrumented(globals()[_n])
+del _n
 
 
 class stream:

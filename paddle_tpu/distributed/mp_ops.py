@@ -18,6 +18,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..profiler import counters as _counters
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def copy_to_mp(x, axis="mp"):
@@ -34,6 +36,9 @@ def _copy_fwd(x, axis):
 
 
 def _copy_bwd(axis, _, g):
+    # Trace-time record: one psum is staged into the XLA program per trace,
+    # not per executed step (the compiled program replays it silently).
+    _counters.inc("dist.mp_collectives")
     return (jax.lax.psum(g, axis),)
 
 
@@ -47,10 +52,13 @@ def reduce_from_mp(x, axis="mp"):
     Place at the output of a row-parallel matmul: members hold partial sums;
     the cotangent of the (replicated) output distributes to each partial
     unchanged."""
+    # Primal path (no grad): custom_vjp runs this body instead of _reduce_fwd.
+    _counters.inc("dist.mp_collectives")
     return jax.lax.psum(x, axis)
 
 
 def _reduce_fwd(x, axis):
+    _counters.inc("dist.mp_collectives")
     return jax.lax.psum(x, axis), None
 
 

@@ -15,11 +15,14 @@ from __future__ import annotations
 import itertools
 
 import threading
+import time as _time
 import weakref
 
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..profiler import counters as _counters
+from ..profiler import host_tracer as _trace
 
 
 class Dataset:
@@ -357,17 +360,22 @@ class _PrefetchIter:
                     cv.notify_all()
 
     def _fetch(self, indices):
-        data = [self._loader.dataset[i] for i in indices]
-        cf = self._loader.collate_fn or default_collate_fn
-        return cf(data)
+        with _trace.span("io.reader"):
+            data = [self._loader.dataset[i] for i in indices]
+            cf = self._loader.collate_fn or default_collate_fn
+            return cf(data)
 
     def __next__(self):
+        t0 = _time.perf_counter_ns()
         with self._cv:
             while True:
                 if self._next_emit in self._results:
                     batch = self._results.pop(self._next_emit)
                     self._next_emit += 1
                     self._cv.notify_all()  # wake backpressured workers
+                    # time this consumer spent blocked on the worker queue
+                    _counters.inc("io.queue_wait_ns",
+                                  _time.perf_counter_ns() - t0)
                     if isinstance(batch, Exception):
                         raise batch
                     return batch
@@ -439,7 +447,9 @@ class DataLoader:
             def gen():
                 cf = self.collate_fn or default_collate_fn
                 for indices in index_iter:
-                    yield cf([self.dataset[i] for i in indices])
+                    with _trace.span("io.reader"):
+                        batch = cf([self.dataset[i] for i in indices])
+                    yield batch
             return gen()
         return _PrefetchIter(self, index_iter)
 
@@ -473,10 +483,14 @@ class DevicePrefetcher:
     def _stage(self, batch):
         import jax
         if isinstance(batch, Tensor):
+            _counters.inc("io.device_put_calls")
+            _counters.inc("io.device_put_bytes", int(batch._data.nbytes))
             return Tensor._wrap(jax.device_put(batch._data, self.device))
         if isinstance(batch, (np.ndarray, np.generic)):
-            return Tensor._wrap(jax.device_put(np.asarray(batch),
-                                               self.device))
+            arr = np.asarray(batch)
+            _counters.inc("io.device_put_calls")
+            _counters.inc("io.device_put_bytes", int(arr.nbytes))
+            return Tensor._wrap(jax.device_put(arr, self.device))
         if isinstance(batch, (list, tuple)):
             return type(batch)(self._stage(b) for b in batch)
         if isinstance(batch, dict):
@@ -486,8 +500,23 @@ class DevicePrefetcher:
     def __iter__(self):
         from collections import deque
         buf = deque()
-        for batch in self.loader:
-            buf.append(self._stage(batch))
+        it = iter(self.loader)
+        while True:
+            with _trace.span("io.prefetcher"):
+                t0 = _time.perf_counter_ns()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                wait = _time.perf_counter_ns() - t0
+                # reader wait is a true stall only when the device buffer is
+                # dry — otherwise the transfer already in flight hides it
+                _counters.inc("io.reader_ns", wait)
+                if not buf:
+                    _counters.inc("io.prefetch_stall_ns", wait)
+                with _trace.span("io.device_put"):
+                    staged = self._stage(batch)
+                buf.append(staged)
             if len(buf) >= self.depth:
                 yield buf.popleft()
         while buf:

@@ -22,8 +22,11 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..core import flags as _flags
 from ..core.state import STATE, no_grad_guard
 from ..core.tensor import Parameter, Tensor
+from ..profiler import counters as _counters
+from ..profiler import host_tracer as _trace
 
 
 def _is_layer(obj):
@@ -34,24 +37,26 @@ def _is_layer(obj):
 # ---------------------------------------------------------------------------
 # State (de)hydration: Layer/Optimizer <-> pytree of jax arrays
 #
-# _HOST_SYNC_COUNTS tallies every hydrate/bind that runs as eager host work
-# (trace-time binds inside jax.jit are one-time compile cost and excluded),
-# so the perf contract of CompiledTrainStep ("zero per-parameter host work in
-# steady state") is checkable: scripts/bench_smoke.py snapshots it around
-# steady-state steps and asserts no movement.
+# The jit.host.* counters (profiler.counters) tally every hydrate/bind that
+# runs as eager host work (trace-time binds inside jax.jit are one-time
+# compile cost and excluded), so the perf contract of CompiledTrainStep
+# ("zero per-parameter host work in steady state") is checkable:
+# scripts/bench_smoke.py and scripts/check_counters.py snapshot the registry
+# around steady-state steps and assert no movement.
 # ---------------------------------------------------------------------------
-_HOST_SYNC_COUNTS = {"layer_state": 0, "bind_layer_state": 0,
-                     "optimizer_state": 0, "bind_optimizer_state": 0}
+_HOST_SYNC_KEYS = ("layer_state", "bind_layer_state", "optimizer_state",
+                   "bind_optimizer_state")
 
 
 def host_sync_counts():
-    """Copy of the hydrate/bind call counters (see scripts/bench_smoke.py)."""
-    return dict(_HOST_SYNC_COUNTS)
+    """Hydrate/bind call counters, as a plain dict (back-compat view over
+    the jit.host.* entries of profiler.counters)."""
+    return {k: _counters.get("jit.host." + k) for k in _HOST_SYNC_KEYS}
 
 
 def layer_state(layer):
     if STATE.tracing_depth == 0:
-        _HOST_SYNC_COUNTS["layer_state"] += 1
+        _counters.inc("jit.host.layer_state")
     params = {k: p._data for k, p in layer.named_parameters()}
     buffers = {k: b._data for k, b in layer.named_buffers()}
     return params, buffers
@@ -59,7 +64,7 @@ def layer_state(layer):
 
 def bind_layer_state(layer, params, buffers):
     if STATE.tracing_depth == 0:
-        _HOST_SYNC_COUNTS["bind_layer_state"] += 1
+        _counters.inc("jit.host.bind_layer_state")
     for k, p in layer.named_parameters():
         if k in params:
             p._data = params[k]
@@ -70,7 +75,7 @@ def bind_layer_state(layer, params, buffers):
 
 def optimizer_state(opt):
     if STATE.tracing_depth == 0:
-        _HOST_SYNC_COUNTS["optimizer_state"] += 1
+        _counters.inc("jit.host.optimizer_state")
     accs = {name: dict(store) for name, store in opt._accumulators.items()}
     masters = dict(opt._master_weights)
     return {"acc": accs, "master": masters}
@@ -78,7 +83,7 @@ def optimizer_state(opt):
 
 def bind_optimizer_state(opt, state):
     if STATE.tracing_depth == 0:
-        _HOST_SYNC_COUNTS["bind_optimizer_state"] += 1
+        _counters.inc("jit.host.bind_optimizer_state")
     opt._accumulators = {name: dict(store)
                          for name, store in state["acc"].items()}
     opt._master_weights = dict(state["master"])
@@ -101,6 +106,7 @@ class StaticFunction:
             return self._cache[train_flag]
 
         def runner(params, buffers, args, kwargs):
+            _counters.inc("jit.traces")  # body runs as python only per trace
             if self._layer is not None:
                 bind_layer_state(self._layer, params, buffers)
             wargs = jax.tree_util.tree_map(
@@ -128,24 +134,30 @@ class StaticFunction:
         return jitted
 
     def __call__(self, *args, **kwargs):
-        params, buffers = (layer_state(self._layer) if self._layer is not None
-                           else ({}, {}))
-        args_data = jax.tree_util.tree_map(
-            lambda x: x._data if isinstance(x, Tensor) else x, args,
-            is_leaf=lambda x: isinstance(x, Tensor))
-        kwargs_data = jax.tree_util.tree_map(
-            lambda x: x._data if isinstance(x, Tensor) else x, kwargs,
-            is_leaf=lambda x: isinstance(x, Tensor))
-        training = self._layer.training if self._layer is not None else False
-        out_data, new_buffers = self._compiled(training)(
-            params, buffers, args_data, kwargs_data)
-        if self._layer is not None:
-            for k, b in self._layer.named_buffers():
-                if k in new_buffers:
-                    b._data = new_buffers[k]
-        return jax.tree_util.tree_map(
-            lambda x: Tensor._wrap(x) if isinstance(x, jax.Array) else x,
-            out_data)
+        with _trace.span("jit.static_function"):
+            params, buffers = (layer_state(self._layer)
+                               if self._layer is not None else ({}, {}))
+            args_data = jax.tree_util.tree_map(
+                lambda x: x._data if isinstance(x, Tensor) else x, args,
+                is_leaf=lambda x: isinstance(x, Tensor))
+            kwargs_data = jax.tree_util.tree_map(
+                lambda x: x._data if isinstance(x, Tensor) else x, kwargs,
+                is_leaf=lambda x: isinstance(x, Tensor))
+            training = (self._layer.training if self._layer is not None
+                        else False)
+            traces_before = _counters.get("jit.traces")
+            out_data, new_buffers = self._compiled(training)(
+                params, buffers, args_data, kwargs_data)
+            _counters.inc("jit.cache_hits"
+                          if _counters.get("jit.traces") == traces_before
+                          else "jit.cache_misses")
+            if self._layer is not None:
+                for k, b in self._layer.named_buffers():
+                    if k in new_buffers:
+                        b._data = new_buffers[k]
+            return jax.tree_util.tree_map(
+                lambda x: Tensor._wrap(x) if isinstance(x, jax.Array) else x,
+                out_data)
 
     @property
     def code(self):
@@ -272,7 +284,10 @@ class CompiledTrainStep:
         self.optimizer = optimizer
         self.scaler = scaler if (scaler is not None
                                  and scaler.is_enable()) else None
-        self._jit = None
+        # keyed by the FLAGS_check_nan_inf value the program was traced
+        # under: the guard's finite-ness checks are part of the XLA program,
+        # so flag-off runs execute a program with zero check overhead
+        self._jits = {}
         self._donate = donate
         # (params, buffers, opt_state, sstate, rng_carry) — device resident
         self._state = None
@@ -293,26 +308,30 @@ class CompiledTrainStep:
         """Read the python objects into the device-resident state tuple."""
         from ..core.state import param_version
         from ..tensor.random import _DEFAULT_GEN
-        params, buffers = layer_state(self.model)
-        opt_state = optimizer_state(self.optimizer)
-        sstate = (self.scaler._traced_state() if self.scaler is not None
-                  else {})
-        self._state = (params, buffers, opt_state, sstate,
-                       _DEFAULT_GEN.next_key())
-        self._seen_version = param_version()
-        self._synced = True
+        with _trace.span("jit.hydrate"):
+            _counters.inc("jit.hydrates")
+            params, buffers = layer_state(self.model)
+            opt_state = optimizer_state(self.optimizer)
+            sstate = (self.scaler._traced_state() if self.scaler is not None
+                      else {})
+            self._state = (params, buffers, opt_state, sstate,
+                           _DEFAULT_GEN.next_key())
+            self._seen_version = param_version()
+            self._synced = True
 
     def sync(self):
         """Flush the device-resident state back into the python
         model/optimizer/scaler objects (pointer rebinds, no host transfer)."""
         if self._state is None or self._synced:
             return
-        params, buffers, opt_state, sstate, _ = self._state
-        bind_layer_state(self.model, params, buffers)
-        bind_optimizer_state(self.optimizer, opt_state)
-        if self.scaler is not None:
-            self.scaler._absorb(sstate)
-        self._synced = True
+        with _trace.span("jit.sync"):
+            _counters.inc("jit.syncs")
+            params, buffers, opt_state, sstate, _ = self._state
+            bind_layer_state(self.model, params, buffers)
+            bind_optimizer_state(self.optimizer, opt_state)
+            if self.scaler is not None:
+                self.scaler._absorb(sstate)
+            self._synced = True
 
     def invalidate(self):
         """Drop the device-resident state; the next call re-hydrates from the
@@ -320,12 +339,13 @@ class CompiledTrainStep:
         self.sync()
         self._state = None
 
-    def _make_jit(self):
+    def _make_jit(self, check_nan_inf=False):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
         scaler = self.scaler
 
         def step_fn(params, buffers, opt_state, lr, rng_key, sstate, args):
             from ..tensor import random as _rnd
+            _counters.inc("jit.traces")  # body runs as python only per trace
             # save the concrete host bindings: they are restored in the
             # finally block so tracers never leak into Parameter._data /
             # optimizer accumulators after the trace finishes
@@ -353,6 +373,20 @@ class CompiledTrainStep:
                                              sstate["scale"])
                 else:
                     loss.backward()
+                checks = {}
+                if check_nan_inf:
+                    # FLAGS_check_nan_inf (reference: eager nan_inf_utils.cc
+                    # hook): finite-ness of loss / per-param grads / updated
+                    # params traced INTO the program; host side raises with
+                    # span context.  Under a GradScaler the grads seen here
+                    # are post-unscale safe values and found_inf reports the
+                    # overflow the scaler already handles.
+                    checks["loss"] = jnp.all(jnp.isfinite(
+                        loss._data.astype(jnp.float32)))
+                    for k, p in model.named_parameters():
+                        if p.grad is not None:
+                            checks["grad:" + k] = jnp.all(jnp.isfinite(
+                                p.grad._data.astype(jnp.float32)))
                 opt.step()
                 opt.clear_grad()
                 new_params = {k: p._data for k, p in model.named_parameters()}
@@ -362,6 +396,12 @@ class CompiledTrainStep:
                     new_params = _skip_select(found, params, new_params)
                     new_opt = _skip_select(found, opt_state, new_opt)
                     sstate = scaler._traced_update(sstate, found)
+                if check_nan_inf:
+                    for k, v in new_params.items():
+                        checks["param:" + k] = jnp.all(jnp.isfinite(
+                            v.astype(jnp.float32)))
+                    if scaler is not None:
+                        checks["found_inf"] = found
                 loss_data = loss._data
             finally:
                 STATE.tracing_depth -= 1
@@ -379,7 +419,7 @@ class CompiledTrainStep:
                 opt._accumulators = saved_accs
                 opt._master_weights = saved_masters
             return (loss_data, new_params, new_buffers, new_opt, sstate,
-                    carry_key)
+                    carry_key, checks)
 
         donate = ()
         if self._donate:
@@ -390,13 +430,20 @@ class CompiledTrainStep:
         return jax.jit(step_fn, donate_argnums=donate)
 
     def __call__(self, *args):
+        with _trace.span("jit.step"):
+            return self._call_impl(args)
+
+    def _call_impl(self, args):
         from ..core.state import param_version
+        _counters.inc("jit.steps")
         hydrated = False
         if self._state is None or param_version() != self._seen_version:
             self._hydrate()
             hydrated = True
-        if self._jit is None:
-            self._jit = self._make_jit()
+        check = bool(_flags.flag("FLAGS_check_nan_inf"))
+        jit_fn = self._jits.get(check)
+        if jit_fn is None:
+            jit_fn = self._jits[check] = self._make_jit(check)
         args_data = jax.tree_util.tree_map(
             lambda x: x._data if isinstance(x, Tensor) else x, args,
             is_leaf=lambda x: isinstance(x, Tensor))
@@ -405,14 +452,22 @@ class CompiledTrainStep:
             self._lr_host = lr_val
             self._lr_dev = jnp.asarray(lr_val, jnp.float32)
         params, buffers, opt_state, sstate, rng_key = self._state
-        (loss, new_params, new_buffers, new_opt, new_sstate,
-         new_rng) = self._jit(params, buffers, opt_state, self._lr_dev,
-                              rng_key, sstate, args_data)
+        traces_before = _counters.get("jit.traces")
+        with _trace.span("jit.dispatch"):
+            (loss, new_params, new_buffers, new_opt, new_sstate,
+             new_rng, checks) = jit_fn(params, buffers, opt_state,
+                                       self._lr_dev, rng_key, sstate,
+                                       args_data)
+        _counters.inc("jit.cache_hits"
+                      if _counters.get("jit.traces") == traces_before
+                      else "jit.cache_misses")
         # bump AFTER the call: at trace time opt.step() does its own bump, so
         # t-based rules (NAdam/RAdam) see the same count an eager step would
         self.optimizer._step_count += 1
         self._state = (new_params, new_buffers, new_opt, new_sstate, new_rng)
         self._synced = False
+        if check and checks:
+            self._raise_if_nonfinite(checks)
         if hydrated:
             # first call after (re)hydration: keep the python objects fresh
             # so "step once, then inspect" retains eager semantics; the
@@ -421,6 +476,29 @@ class CompiledTrainStep:
         from ..distributed.elastic import heartbeat
         heartbeat()  # no-op unless under the elastic launcher
         return Tensor._wrap(loss)
+
+    def _raise_if_nonfinite(self, checks):
+        """FLAGS_check_nan_inf host side: pull the traced finite-ness bits
+        (a deliberate host sync — this is a debug mode) and raise with the
+        offending phase names and the current span context."""
+        with _trace.span("jit.nan_inf_check"):
+            _counters.inc("jit.nan_inf_checks")
+            bad = sorted(k for k, v in checks.items()
+                         if k != "found_inf" and not bool(v))
+            if not bad:
+                return
+            if self.scaler is not None and bool(checks.get("found_inf")):
+                # fp16 overflow step: the scaler skipped the update and will
+                # shrink the scale — expected dynamics, not a defect
+                return
+            _counters.inc("jit.nan_inf_hits")
+            shown = ", ".join(bad[:8]) + (f" (+{len(bad) - 8} more)"
+                                          if len(bad) > 8 else "")
+            stack = _trace.current_stack()
+            ctx = f" [active spans: {' > '.join(stack)}]" if stack else ""
+            raise FloatingPointError(
+                f"FLAGS_check_nan_inf: non-finite values at train step "
+                f"{self.optimizer._step_count}: {shown}{ctx}")
 
 
 import contextlib

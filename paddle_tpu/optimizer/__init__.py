@@ -15,6 +15,8 @@ import numpy as np
 from ..core import dtype as dtypes
 from ..core.state import no_grad_guard
 from ..core.tensor import Parameter, Tensor
+from ..profiler import counters as _counters
+from ..profiler import host_tracer as _trace
 from . import lr  # noqa: F401
 from .lr import LRScheduler
 
@@ -106,7 +108,8 @@ class Optimizer:
 
     def step(self):
         from ..core.selected_rows import SelectedRows
-        with no_grad_guard():
+        _counters.inc("optimizer.steps")
+        with _trace.span("optimizer.step"), no_grad_guard():
             pg = self._collect_params_grads()
             if self._grad_clip is not None:
                 if getattr(self._grad_clip, "_handles_selected_rows", False):

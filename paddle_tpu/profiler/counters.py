@@ -1,0 +1,103 @@
+"""Process-global counter/gauge registry.
+
+Reference analogue: the fleet metric tables and the profiler's aggregate
+stats (SURVEY §"Metrics / logging / observability") — named monotonically
+increasing counters that the runtime bumps on every hot-path event, cheap
+enough to stay always-on.  Unlike host-tracer spans (gated by
+``FLAGS_host_trace_level``), counters are never disabled: they are the
+substrate perf contracts are asserted against (``scripts/bench_smoke.py``,
+``scripts/check_counters.py``).
+
+Well-known names (see README "Observability" for the full table):
+
+  jit.steps / jit.traces / jit.cache_hits / jit.cache_misses
+  jit.hydrates / jit.syncs
+  jit.host.layer_state / jit.host.bind_layer_state /
+  jit.host.optimizer_state / jit.host.bind_optimizer_state
+  static.runs / static.compiles / static.traces
+  io.device_put_calls / io.device_put_bytes
+  io.reader_ns / io.prefetch_stall_ns / io.queue_wait_ns
+  dist.collectives / dist.<op> / dist.mp_collectives
+  optimizer.steps
+"""
+
+from __future__ import annotations
+
+import threading
+
+_LOCK = threading.Lock()
+_COUNTERS: dict[str, float] = {}
+_GAUGES: dict[str, float] = {}
+
+
+def inc(name: str, value=1):
+    """Bump a monotonic counter (thread-safe)."""
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + value
+
+
+def set_gauge(name: str, value):
+    """Set a point-in-time gauge (last-write-wins)."""
+    with _LOCK:
+        _GAUGES[name] = value
+
+
+def get(name: str, default=0):
+    return _COUNTERS.get(name, _GAUGES.get(name, default))
+
+
+def names():
+    with _LOCK:
+        return sorted(set(_COUNTERS) | set(_GAUGES))
+
+
+def snapshot() -> dict:
+    """Copy of every counter and gauge — the unit of delta accounting."""
+    with _LOCK:
+        out = dict(_COUNTERS)
+        out.update(_GAUGES)
+        return out
+
+
+def delta(before: dict, after: dict | None = None) -> dict:
+    """Per-name movement between two snapshots (``after`` defaults to now).
+    Names absent from ``before`` count from 0; zero deltas are dropped."""
+    if after is None:
+        after = snapshot()
+    out = {}
+    for k, v in after.items():
+        d = v - before.get(k, 0)
+        if d != 0:
+            out[k] = d
+    return out
+
+
+def reset(name: str | None = None):
+    """Zero one counter/gauge, or all of them (test isolation)."""
+    with _LOCK:
+        if name is None:
+            _COUNTERS.clear()
+            _GAUGES.clear()
+        else:
+            _COUNTERS.pop(name, None)
+            _GAUGES.pop(name, None)
+
+
+def allreduce(group=None) -> dict:
+    """Fleet view: element-wise sum of every rank's counters (reference: the
+    allreduce'd fleet metric tables).  Single-process: a plain snapshot."""
+    local = snapshot()
+    try:
+        from ..distributed import get_world_size
+        if get_world_size() <= 1:
+            return local
+    except Exception:
+        return local
+    from ..distributed.communication import all_gather_object
+    gathered: list = []
+    all_gather_object(gathered, local, group=group)
+    out: dict = {}
+    for snap in gathered:
+        for k, v in snap.items():
+            out[k] = out.get(k, 0) + v
+    return out

@@ -24,8 +24,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import flags as _flags
 from ..core.state import STATE
 from ..core.tensor import Tensor
+from ..profiler import counters as _counters
+from ..profiler import host_tracer as _trace
 
 
 class InputSpec:
@@ -295,6 +298,11 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        with _trace.span("static.executor_run"):
+            return self._run_impl(program, feed, fetch_list, **kwargs)
+
+    def _run_impl(self, program=None, feed=None, fetch_list=None, **kwargs):
+        _counters.inc("static.runs")
         feed = feed or {}
         # legacy convenience: Executor.run(callable)
         if callable(program) and not isinstance(program, Program):
@@ -351,6 +359,7 @@ class Executor:
                     grad_groups.setdefault(a, []).append((i, b))
 
             def replay(feeds, exts, rng_root):
+                _counters.inc("static.traces")  # python body runs per trace
                 env0 = dict(zip(feed_vids, feeds))
                 env0.update(zip(ext_vids, exts))
                 env = program._run_nodes(dict(env0), rng_root=rng_root)
@@ -378,9 +387,23 @@ class Executor:
 
             compiled = jax.jit(replay)
             program._compile_cache[key] = compiled
+            _counters.inc("static.compiles")
         from ..tensor.random import _DEFAULT_GEN
-        outs = compiled(feed_vals, ext_vals, _DEFAULT_GEN.next_key())
-        return [np.asarray(o) for o in outs]
+        with _trace.span("static.dispatch"):
+            outs = compiled(feed_vals, ext_vals, _DEFAULT_GEN.next_key())
+            results = [np.asarray(o) for o in outs]
+        if _flags.flag("FLAGS_check_nan_inf"):
+            bad = [i for i, r in enumerate(results)
+                   if np.issubdtype(r.dtype, np.floating)
+                   and not np.isfinite(r).all()]
+            if bad:
+                stack = _trace.current_stack()
+                ctx = (f" [active spans: {' > '.join(stack)}]" if stack
+                       else "")
+                raise FloatingPointError(
+                    f"FLAGS_check_nan_inf: non-finite values in Executor.run "
+                    f"fetch indices {bad}{ctx}")
+        return results
 
     def close(self):
         pass

@@ -2,7 +2,10 @@
 
 Steps 2-3 (steady state) must do ZERO host-side hydrate/bind work — the
 device-resident contract of jit.CompiledTrainStep, watched through the
-jit.host_sync_counts() counters.  Prints one JSON line; raises on violation.
+process-global ``paddle_tpu.profiler.counters`` registry (jit.host.* keys;
+``jit.host_sync_counts()`` is now a view over the same counters).  Step 3
+must additionally be a pure cache hit: zero retraces (``jit.traces``).
+Prints one JSON line; raises on violation.
 
 Run directly (``python scripts/bench_smoke.py``), via ``PTPU_BENCH_SMOKE=1
 python bench.py``, or through tests/test_train_step_state.py (tier-1).
@@ -19,6 +22,7 @@ def run():
     import paddle_tpu.jit as pjit
     from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
                                    GPTPretrainingCriterion)
+    from paddle_tpu.profiler import counters
 
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
@@ -34,21 +38,36 @@ def run():
 
     step = pjit.CompiledTrainStep(model, loss_fn, opt)
     losses = [float(step(ids, labels).numpy())]  # step 1: hydrate + compile
-    before = pjit.host_sync_counts()
+    before = counters.snapshot()
     losses.append(float(step(ids, labels).numpy()))  # step 2 (retrace only)
+    mid = counters.snapshot()
     losses.append(float(step(ids, labels).numpy()))  # step 3 (cached)
-    after = pjit.host_sync_counts()
-    delta = {k: after[k] - before[k] for k in after}
+    after = counters.snapshot()
+
+    host_keys = ["jit.host." + k for k in pjit._HOST_SYNC_KEYS]
+    host_keys += ["jit.hydrates", "jit.syncs"]
+    steady = counters.delta(before, after)
+    host_delta = {k: steady.get(k, 0) for k in host_keys}
+    step3 = counters.delta(mid, after)
 
     result = {"metric": "steady_state_host_syncs",
-              "value": sum(delta.values()),
+              "value": sum(host_delta.values()),
               "unit": "calls/2 steps",
-              "delta": delta,
+              "delta": host_delta,
+              "step3_retraces": step3.get("jit.traces", 0),
+              "counters": {k: v for k, v in steady.items()
+                           if k.startswith(("jit.", "io.", "dist.",
+                                            "optimizer."))},
               "losses": [round(l, 6) for l in losses]}
     print(json.dumps(result))
-    if sum(delta.values()) != 0:
+    if sum(host_delta.values()) != 0:
         raise AssertionError(
-            f"steady-state steps did host hydrate/bind work: {delta}")
+            f"steady-state steps did host hydrate/bind work: {host_delta}")
+    if result["step3_retraces"] != 0:
+        raise AssertionError(
+            f"step 3 retraced: jit.traces += {result['step3_retraces']} "
+            "(expected a pure jit cache hit after the step-2 "
+            "accumulator-structure retrace)")
     if not all(np.isfinite(l) for l in losses):
         raise AssertionError(f"non-finite loss in smoke run: {losses}")
     return result

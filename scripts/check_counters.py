@@ -1,0 +1,79 @@
+"""Counter-verified steady-state gate: a short CompiledTrainStep run must
+reach a zero-python-overhead steady state, proven by the process-global
+``paddle_tpu.profiler.counters`` registry rather than by timing.
+
+Protocol: 2 warmup steps (step 1 hydrates + traces, step 2 retraces once —
+the optimizer accumulators change the carried-state structure), then 2
+measured steps which must show:
+
+  * 0 retraces           (jit.traces — the python step body never re-runs)
+  * 0 rehydrations       (jit.hydrates)
+  * 0 host bind/sync work (jit.host.*, jit.syncs)
+  * 2 cache hits, 0 misses (every dispatch is a pure jit-cache hit)
+
+Prints one JSON line; raises AssertionError on any violation.  Wired as a
+tier-1 test via tests/test_profiler.py.  Run directly:
+``python scripts/check_counters.py``.
+"""
+
+import json
+import os
+
+WARMUP = 2
+MEASURE = 2
+
+
+def run():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.jit as pjit
+    import paddle_tpu.nn as nn
+    from paddle_tpu.profiler import counters
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    x = paddle.randn([8, 16])
+    y = paddle.randn([8, 4])
+
+    def loss_fn(m, a, b):
+        return ((m(a) - b) ** 2).mean()
+
+    step = pjit.CompiledTrainStep(model, loss_fn, opt)
+    for _ in range(WARMUP):
+        step(x, y).numpy()
+    before = counters.snapshot()
+    for _ in range(MEASURE):
+        step(x, y).numpy()
+    steady = counters.delta(before)
+
+    invariants = {
+        "jit.traces": 0,
+        "jit.hydrates": 0,
+        "jit.syncs": 0,
+        "jit.cache_misses": 0,
+        "jit.cache_hits": MEASURE,
+        "jit.steps": MEASURE,
+    }
+    invariants.update({"jit.host." + k: 0 for k in pjit._HOST_SYNC_KEYS})
+
+    violations = {k: (steady.get(k, 0), want)
+                  for k, want in invariants.items()
+                  if steady.get(k, 0) != want}
+    result = {"metric": "steady_state_counter_violations",
+              "value": len(violations),
+              "unit": f"violations/{MEASURE} steps",
+              "violations": {k: {"got": got, "want": want}
+                             for k, (got, want) in violations.items()},
+              "steady_delta": steady}
+    print(json.dumps(result))
+    if violations:
+        raise AssertionError(
+            "steady-state counter invariants violated (got != want): "
+            + ", ".join(f"{k}: {got} != {want}"
+                        for k, (got, want) in sorted(violations.items())))
+    return result
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,333 @@
+"""Observability layer: host tracer, Profiler scheduler, counter registry,
+NaN/Inf guard, and the counter-verified steady-state gate."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as pjit
+import paddle_tpu.nn as nn
+from paddle_tpu import profiler
+from paddle_tpu.core import flags as core_flags
+from paddle_tpu.profiler import (ProfilerState, ProfilerTarget, counters,
+                                 host_tracer, make_scheduler)
+
+
+@pytest.fixture(autouse=True)
+def _restore_trace_flags():
+    """Tests toggle process-global flags; leave them as found."""
+    level = core_flags.flag("FLAGS_host_trace_level")
+    nan = core_flags.flag("FLAGS_check_nan_inf")
+    yield
+    core_flags.set_flags({"FLAGS_host_trace_level": level,
+                          "FLAGS_check_nan_inf": nan})
+    if host_tracer.is_collecting():
+        host_tracer.stop()
+
+
+def _tiny_step(poison=False):
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    x = paddle.randn([4, 8])
+    y = paddle.randn([4, 4])
+
+    def loss_fn(m, a, b):
+        loss = ((m(a) - b) ** 2).mean()
+        if poison:
+            loss = paddle.log(loss - 1e9)  # log(negative) -> nan
+        return loss
+
+    return pjit.CompiledTrainStep(model, loss_fn, opt), x, y
+
+
+class TestMakeScheduler:
+    def test_state_sequence(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                               skip_first=2)
+        S = ProfilerState
+        want = [S.CLOSED, S.CLOSED,                           # skip_first
+                S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,  # window 1
+                S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,  # window 2
+                S.CLOSED, S.CLOSED]                           # repeat done
+        assert [sched(i) for i in range(len(want))] == want
+
+    def test_record_one_is_record_and_return(self):
+        sched = make_scheduler(closed=0, ready=0, record=1)
+        assert sched(0) == ProfilerState.RECORD_AND_RETURN
+        assert sched(7) == ProfilerState.RECORD_AND_RETURN
+
+    def test_repeat_zero_repeats_forever(self):
+        sched = make_scheduler(closed=1, ready=0, record=1, repeat=0)
+        assert sched(999) == ProfilerState.RECORD_AND_RETURN
+        assert sched(998) == ProfilerState.CLOSED
+
+    @pytest.mark.parametrize("record", [0, -1, 1.5, "2"])
+    def test_record_must_be_positive_int(self, record):
+        with pytest.raises(ValueError, match="record should be a positive"):
+            make_scheduler(closed=1, ready=1, record=record)
+
+    @pytest.mark.parametrize("kw", ["closed", "ready", "repeat", "skip_first"])
+    def test_nonnegative_args_validated(self, kw):
+        kwargs = dict(closed=1, ready=1, record=1, repeat=0, skip_first=0)
+        kwargs[kw] = -1
+        with pytest.raises(ValueError,
+                           match=f"{kw} should be a non-negative integer"):
+            make_scheduler(**kwargs)
+
+
+class TestHostTracer:
+    def test_disabled_level_returns_null_singleton(self):
+        core_flags.set_flags({"FLAGS_host_trace_level": 0})
+        host_tracer.start()
+        try:
+            s1 = host_tracer.span("a")
+            s2 = host_tracer.span("b")
+            assert s1 is s2  # shared no-op: zero allocation when off
+            with s1:
+                pass
+            assert host_tracer.span_count() == 0
+        finally:
+            host_tracer.stop()
+
+    def test_no_session_records_nothing(self):
+        core_flags.set_flags({"FLAGS_host_trace_level": 1})
+        assert not host_tracer.is_collecting()
+        before = host_tracer.span_count()
+        with host_tracer.span("orphan"):
+            pass
+        assert host_tracer.span_count() == before
+
+    def test_level2_sites_gated(self):
+        core_flags.set_flags({"FLAGS_host_trace_level": 1})
+        host_tracer.start()
+        try:
+            with host_tracer.span("fine_grained", level=2):
+                pass
+            with host_tracer.span("coarse", level=1):
+                pass
+            names = [e[0] for e in host_tracer.events()]
+            assert names == ["coarse"]
+        finally:
+            host_tracer.stop()
+
+    def test_nested_spans_and_multithread_tids(self):
+        core_flags.set_flags({"FLAGS_host_trace_level": 1})
+        host_tracer.start()
+
+        def worker():
+            with host_tracer.span("worker_outer"):
+                with host_tracer.span("worker_inner"):
+                    pass
+
+        try:
+            with host_tracer.span("main_outer"):
+                assert host_tracer.current_stack() == ["main_outer"]
+                with host_tracer.span("main_inner"):
+                    assert host_tracer.current_stack() == ["main_outer",
+                                                           "main_inner"]
+            t = threading.Thread(target=worker, name="trace_worker")
+            t.start()
+            t.join()
+        finally:
+            evts = host_tracer.stop()
+
+        by_name = {e[0]: e for e in evts}
+        assert by_name["main_inner"][4] == 1      # depth
+        assert by_name["main_outer"][4] == 0
+        main_tid = by_name["main_outer"][1]
+        worker_tid = by_name["worker_outer"][1]
+        assert main_tid != worker_tid
+        # nesting: inner interval inside outer interval, same thread
+        assert by_name["main_inner"][1] == main_tid
+        assert (by_name["main_outer"][2] <= by_name["main_inner"][2]
+                and by_name["main_inner"][3] <= by_name["main_outer"][3])
+
+        trace = host_tracer.to_chrome_trace(evts)
+        # loadable chrome trace-event JSON
+        trace = json.loads(json.dumps(trace))
+        xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        ms = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+        assert {e["name"] for e in xs} == {"main_outer", "main_inner",
+                                           "worker_outer", "worker_inner"}
+        assert len({e["tid"] for e in xs}) == 2
+        assert any(e["name"] == "thread_name"
+                   and e["args"]["name"] == "trace_worker" for e in ms)
+        for e in xs:
+            assert e["dur"] >= 0 and isinstance(e["ts"], float)
+
+    def test_summary_table(self):
+        core_flags.set_flags({"FLAGS_host_trace_level": 1})
+        host_tracer.start()
+        try:
+            for _ in range(3):
+                with host_tracer.span("repeated"):
+                    pass
+        finally:
+            evts = host_tracer.stop()
+        table = host_tracer.summary(evts)
+        assert "repeated" in table and "Calls" in table
+        row = next(l for l in table.splitlines() if l.startswith("repeated"))
+        assert row.split()[1] == "3"
+
+
+class TestCounters:
+    def test_inc_get_snapshot_delta(self):
+        counters.reset("test.alpha")
+        counters.reset("test.beta")
+        before = counters.snapshot()
+        counters.inc("test.alpha")
+        counters.inc("test.alpha", 4)
+        counters.inc("test.beta", 2)
+        assert counters.get("test.alpha") == 5
+        d = counters.delta(before)
+        assert d["test.alpha"] == 5 and d["test.beta"] == 2
+        # zero-movement keys are dropped from deltas
+        assert all(v != 0 for v in d.values())
+
+    def test_reset(self):
+        counters.inc("test.gamma", 7)
+        counters.reset("test.gamma")
+        assert counters.get("test.gamma") == 0
+        counters.inc("test.gamma", 1)
+        counters.reset()
+        assert counters.get("test.gamma") == 0
+
+    def test_gauge(self):
+        counters.set_gauge("test.gauge", 42)
+        assert counters.snapshot()["test.gauge"] == 42
+
+    def test_allreduce_single_process_is_snapshot(self):
+        counters.inc("test.ar", 3)
+        red = counters.allreduce()
+        assert red["test.ar"] == counters.get("test.ar")
+
+
+class TestProfilerFrontend:
+    def test_three_step_run_summary_and_chrome_trace(self, tmp_path):
+        core_flags.set_flags({"FLAGS_host_trace_level": 1})
+        step, x, y = _tiny_step()
+        handler = profiler.export_chrome_tracing(str(tmp_path), "w0")
+        with profiler.Profiler(targets=[ProfilerTarget.CPU],
+                               on_trace_ready=handler) as prof:
+            for _ in range(3):
+                step(x, y)
+                prof.step()
+        assert prof._chrome_trace_path.endswith("w0.pt.trace.json")
+        trace = profiler.load_profiler_result(prof._chrome_trace_path)
+        names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+        # acceptance: spans from the jit hot path present in the export
+        assert {"jit.step", "jit.dispatch", "jit.hydrate"} <= names
+        assert "optimizer.step" in names  # traced during step-1 compile
+        table = prof.summary()
+        assert "jit.step" in table and "Calls" in table
+        assert "jit.step" in profiler.summary()  # module-level convenience
+
+    def test_scheduler_windows_collect_only_record_steps(self):
+        core_flags.set_flags({"FLAGS_host_trace_level": 1})
+        ready_count = [0]
+        prof = profiler.Profiler(
+            scheduler=make_scheduler(closed=1, ready=0, record=1, repeat=1),
+            on_trace_ready=lambda p: ready_count.__setitem__(
+                0, ready_count[0] + 1))
+        prof.start()
+        for i in range(4):
+            with profiler.RecordEvent(f"user_step_{i}"):
+                pass
+            prof.step()
+        prof.stop()
+        names = {e[0] for e in prof._events}
+        assert "user_step_1" in names       # the RECORD_AND_RETURN step
+        assert "user_step_0" not in names   # CLOSED step
+        assert ready_count[0] == 1
+
+    def test_timer_only_step_info(self):
+        prof = profiler.Profiler(timer_only=True)
+        prof.start()
+        for _ in range(3):
+            prof.step(num_samples=8)
+        info = prof.step_info()
+        prof.stop()
+        assert "reader_cost:" in info and "batch_cost:" in info
+        ips = float(info.split("ips:")[1].split()[0])
+        assert ips > 0
+        assert "samples/s" in info
+        # window resets after step_info (paddle semantics)
+        assert prof.step_info() == "(no steps recorded)"
+
+    def test_record_event_begin_end(self):
+        core_flags.set_flags({"FLAGS_host_trace_level": 1})
+        host_tracer.start()
+        try:
+            ev = profiler.RecordEvent("manual")
+            ev.begin()
+            ev.end()
+            assert [e[0] for e in host_tracer.events()] == ["manual"]
+        finally:
+            host_tracer.stop()
+
+
+class TestNanInfGuard:
+    def test_poisoned_loss_raises_with_span_context(self):
+        core_flags.set_flags({"FLAGS_check_nan_inf": 1})
+        step, x, y = _tiny_step(poison=True)
+        with pytest.raises(FloatingPointError,
+                           match="FLAGS_check_nan_inf: non-finite"):
+            step(x, y)
+
+    def test_clean_loss_passes_with_guard_on(self):
+        core_flags.set_flags({"FLAGS_check_nan_inf": 1})
+        step, x, y = _tiny_step()
+        loss = step(x, y)
+        assert np.isfinite(float(loss.numpy()))
+        assert True in step._jits  # guard variant compiled
+
+    def test_guard_off_is_zero_overhead(self):
+        core_flags.set_flags({"FLAGS_check_nan_inf": 0})
+        step, x, y = _tiny_step(poison=True)
+        loss = step(x, y)  # no raise: checks not traced into the program
+        assert not np.isfinite(float(loss.numpy()))
+        assert set(step._jits) == {False}  # only the unguarded jit entry
+
+    def test_toggling_flag_switches_jit_entry(self):
+        step, x, y = _tiny_step()
+        core_flags.set_flags({"FLAGS_check_nan_inf": 0})
+        step(x, y)
+        core_flags.set_flags({"FLAGS_check_nan_inf": 1})
+        step(x, y)
+        assert set(step._jits) == {False, True}
+
+
+class TestSteadyStateZeroTracing:
+    def test_level0_steady_step_records_zero_spans(self):
+        """Acceptance: FLAGS_host_trace_level=0 -> a steady-state step makes
+        zero span records even inside an active collection session."""
+        step, x, y = _tiny_step()
+        for _ in range(3):
+            step(x, y)  # warm: hydrate + both traces done
+        core_flags.set_flags({"FLAGS_host_trace_level": 0})
+        host_tracer.start()
+        try:
+            before = counters.snapshot()
+            step(x, y)
+            d = counters.delta(before)
+            assert host_tracer.span_count() == 0
+            assert d.get("jit.cache_hits") == 1  # it really was a steady step
+        finally:
+            host_tracer.stop()
+
+
+class TestCheckCountersGate:
+    def test_steady_state_counter_gate(self):
+        import importlib.util
+        import pathlib
+        path = (pathlib.Path(__file__).resolve().parent.parent / "scripts"
+                / "check_counters.py")
+        spec = importlib.util.spec_from_file_location("check_counters", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        result = mod.run()
+        assert result["value"] == 0
