@@ -14,8 +14,13 @@ Legs (perf round 5):
   — the largest model whose AdamW state (bf16 params + fp32 master + 2
   fp32 moments ~ 10.6G) fits the 15.75G chip.  Measured 0.468 MFU (512/512 flash blocks, r5 sweep).
 - gpt125m (regression leg): round-4's config, batch 16 x 1024, selective
-  remat — small-model overhead regression guard.
-Set PTPU_BENCH=125m|760m to run a single leg.
+  remat — small-model overhead regression guard.  Runs twice: single-step
+  dispatch, then fused multi-step dispatch (``fused_steps=K``, one XLA
+  launch per K steps) — the reported ``fused_speedup`` is the
+  dispatch-amortisation win on the leg most exposed to per-step python
+  overhead.
+Set PTPU_BENCH=125m|760m to run a single leg.  PTPU_FUSED_STEPS sets the
+fused window length K (default 4; 1 disables the fused leg).
 """
 
 import json
@@ -25,8 +30,9 @@ import time
 import numpy as np
 
 
-def _run_leg(cfg, batch, seq, iters, rounds):
+def _run_leg(cfg, batch, seq, iters, rounds, fused_steps=1):
     import paddle_tpu as paddle
+    from paddle_tpu.io import Window
     from paddle_tpu.jit import CompiledTrainStep
     from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
 
@@ -39,26 +45,39 @@ def _run_leg(cfg, batch, seq, iters, rounds):
     def loss_fn(m, x, l):
         return crit(m(x), l)
 
-    step = CompiledTrainStep(model, loss_fn, opt)
-    # warmup / compile (2 structures: empty accs then full), timed per phase:
-    # compile_s covers hydrate + both traces + XLA compiles; first_step_s is
-    # the first fully-cached dispatch; steady_step_s is the measured median.
+    k = max(1, int(fused_steps))
+    step = CompiledTrainStep(model, loss_fn, opt, fused_steps=k)
+    if k > 1:
+        win = Window(
+            (paddle.to_tensor(np.stack([np.asarray(ids.numpy())] * k)),
+             paddle.to_tensor(np.stack([np.asarray(labels.numpy())] * k))),
+            k)
+        dispatch = lambda: step(win)
+    else:
+        dispatch = lambda: step(ids, labels)
+    # warmup / compile, timed per phase: 2 warmup dispatches in both modes.
+    # Single-step mode traces 2 structures (empty accs then full); fused
+    # mode runs window 1 as the priming single-step fallback (both acc
+    # structures) and window 2 as the scan compile.  compile_s covers
+    # hydrate + all traces + XLA compiles; first_step_s is the first fully
+    # cached dispatch; steady_step_s is the measured median.
     t0 = time.perf_counter()
-    step(ids, labels)
-    step(ids, labels).numpy()
+    dispatch()
+    dispatch().numpy()
     compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    step(ids, labels).numpy()
+    dispatch().numpy()
     first_step_s = time.perf_counter() - t0
 
+    n_windows = max(1, iters // k)
     rates = []
     for _ in range(rounds):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            loss = step(ids, labels)
+        for _ in range(n_windows):
+            loss = dispatch()
         loss.numpy()  # sync
         dt = time.perf_counter() - t0
-        rates.append(batch * seq * iters / dt)
+        rates.append(batch * seq * k * n_windows / dt)
     tokens_per_sec = float(np.median(rates))
     spread = (float(np.max(rates) - np.min(rates)) / tokens_per_sec
               if len(rates) > 1 else 0.0)
@@ -88,16 +107,26 @@ def main():
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     peak = 197e12  # v5e bf16 peak (394e12 is int8)
 
+    fused_k = int(os.environ.get("PTPU_FUSED_STEPS", "4"))
+
     if not on_tpu:  # CPU fallback so the bench always produces a line
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=128,
                         use_flash_attention=False)
-        tps, spread, _, phases = _run_leg(cfg, 2, 128, 3, 1)
-        print(json.dumps({"metric": "gpt_tiny_cpu_tokens_per_sec",
-                          "value": round(tps, 2), "unit": "tokens/s",
-                          "vs_baseline": 0.0,
-                          "spread_frac": round(spread, 4),
-                          "phases": phases}))
+        tps, spread, _, phases = _run_leg(cfg, 2, 128, 4, 1)
+        out = {"metric": "gpt_tiny_cpu_tokens_per_sec",
+               "value": round(tps, 2), "unit": "tokens/s",
+               "vs_baseline": 0.0,
+               "spread_frac": round(spread, 4),
+               "phases": phases}
+        if fused_k > 1:
+            ftps, _, _, fphases = _run_leg(cfg, 2, 128, 4, 1,
+                                           fused_steps=fused_k)
+            out["fused"] = {"fused_steps": fused_k,
+                            "tokens_per_sec": round(ftps, 2),
+                            "fused_speedup": round(ftps / tps, 4),
+                            "phases": fphases}
+        print(json.dumps(out))
         return
 
     which = os.environ.get("PTPU_BENCH", "all")
@@ -126,6 +155,19 @@ def main():
                            "mfu": round(tps * 6 * n / peak, 4),
                            "spread_frac": round(spread, 4),
                            "phases": phases}
+        if fused_k > 1:
+            # fused-dispatch leg: same model/config, K steps per XLA
+            # launch — isolates the per-step python dispatch overhead
+            # that the 125m leg is most exposed to
+            ftps, fspread, n, fphases = _run_leg(cfg, 16, 1024, 16, 3,
+                                                 fused_steps=fused_k)
+            legs["gpt125m_fused"] = {
+                "fused_steps": fused_k,
+                "tokens_per_sec": round(ftps, 2),
+                "mfu": round(ftps * 6 * n / peak, 4),
+                "fused_speedup": round(ftps / tps, 4),
+                "spread_frac": round(fspread, 4),
+                "phases": fphases}
 
     flag = "gpt760m" if "gpt760m" in legs else "gpt125m"
     print(json.dumps({

@@ -83,3 +83,8 @@ define_flag("FLAGS_host_trace_level", 1,
             "host tracer verbosity (reference: FLAGS_host_trace_level, "
             "host_tracer.cc): 0 disables span recording entirely; 1 records "
             "framework phase spans; 2 adds high-frequency spans")
+define_flag("FLAGS_fused_steps", 1,
+            "jit.CompiledTrainStep fused-dispatch window: scan this many "
+            "training steps per XLA launch (1 = one dispatch per step). "
+            "Amortizes per-step python dispatch cost for short steps — the "
+            "scheduling-overhead analogue of new_executor/CINN fusion.")

@@ -523,6 +523,112 @@ class DevicePrefetcher:
             yield buf.popleft()
 
 
+class Window(tuple):
+    """A window of ``k`` training batches stacked along a new leading axis,
+    ready for fused multi-step dispatch (``jit.CompiledTrainStep`` with
+    ``fused_steps=k``).
+
+    A ``Window`` IS the tuple of stacked step-arguments (``step(*w)``
+    unpacks them), carrying the window length as ``.k`` so partial tail
+    windows (loader length not a multiple of k) stay self-describing —
+    the compiled step falls back to single-step dispatch for them instead
+    of dropping or padding batches.
+    """
+
+    def __new__(cls, args, k):
+        self = tuple.__new__(cls, tuple(args))
+        self.k = int(k)
+        return self
+
+
+class StackingPrefetcher:
+    """Window feeder for fused multi-step dispatch: stages the next ``k``
+    batches on device (through a ``DevicePrefetcher``) and stacks them into
+    one ``Window`` while the current window is still executing.
+
+    The stack itself (``jnp.stack`` over already-staged device arrays) is
+    async XLA work, so neither the host->device copies nor the stacking sit
+    on the step critical path.  Batch values are bit-identical to the plain
+    loader's; only placement/grouping changes.
+
+        loader = paddle_tpu.io.DataLoader(ds, batch_size=64)
+        step = jit.CompiledTrainStep(model, loss_fn, opt, fused_steps=4)
+        for w in paddle_tpu.io.StackingPrefetcher(loader, k=4):
+            losses = step(*w)      # ONE XLA launch for 4 steps
+
+    Drain edge: when the loader length is not a multiple of ``k`` (or a
+    trailing batch changes shape, e.g. a drop_last=False remainder batch),
+    the leftover batches are emitted as a partial ``Window`` (``w.k < k``)
+    — never dropped, never padded; the compiled step runs them as single
+    steps.
+    """
+
+    def __init__(self, loader, k, depth=None, device=None):
+        self.loader = loader
+        self.k = max(1, int(k))
+        # double-buffer in window units: the next window's batches stage
+        # while the current window runs
+        depth = 2 * self.k if depth is None else max(1, int(depth))
+        self._pref = DevicePrefetcher(loader, depth=depth, device=device)
+
+    def __len__(self):
+        n = len(self.loader)
+        return (n + self.k - 1) // self.k
+
+    @staticmethod
+    def _spec(batch):
+        if isinstance(batch, Tensor):
+            return ("t", tuple(batch._data.shape), str(batch._data.dtype))
+        if isinstance(batch, (list, tuple)):
+            return tuple(StackingPrefetcher._spec(b) for b in batch)
+        if isinstance(batch, dict):
+            return {k: StackingPrefetcher._spec(v)
+                    for k, v in sorted(batch.items())}
+        return ("py", type(batch).__name__)
+
+    @staticmethod
+    def _stack(items):
+        import jax.numpy as jnp
+        first = items[0]
+        if isinstance(first, Tensor):
+            return Tensor._wrap(jnp.stack([t._data for t in items]))
+        if isinstance(first, (list, tuple)):
+            return type(first)(StackingPrefetcher._stack([b[i] for b in items])
+                               for i in range(len(first)))
+        if isinstance(first, dict):
+            return {k: StackingPrefetcher._stack([b[k] for b in items])
+                    for k in first}
+        return Tensor._wrap(jnp.stack([jnp.asarray(x) for x in items]))
+
+    def _emit(self, batches):
+        with _trace.span("io.stack_window"):
+            _counters.inc("io.stack_windows")
+            _counters.inc("io.stack_batches", len(batches))
+            stacked = self._stack(batches)
+            args = stacked if isinstance(stacked, tuple) else (stacked,)
+            return Window(args, len(batches))
+
+    def __iter__(self):
+        pending = []
+        spec0 = None
+        for staged in self._pref:
+            s = self._spec(staged)
+            if pending and s != spec0:
+                # shape/structure break (e.g. a drop_last=False remainder
+                # batch): flush what accumulated as a partial window
+                yield self._emit(pending)
+                pending = []
+            if not pending:
+                spec0 = s
+            pending.append(staged)
+            if len(pending) == self.k:
+                yield self._emit(pending)
+                pending = []
+        if pending:
+            # loader length not a multiple of k: partial tail window
+            yield self._emit(pending)
+
+
 def get_worker_info():
     return None
 

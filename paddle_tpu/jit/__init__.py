@@ -269,6 +269,38 @@ class CompiledTrainStep:
     Raw ``tensor._data = ...`` pokes are NOT tracked — call
     ``step.invalidate()`` after such surgery.
 
+    Fused multi-step dispatch: with ``fused_steps=K`` (default from
+    ``FLAGS_fused_steps``) a whole K-step window compiles into ONE donated
+    XLA program — ``jax.lax.scan`` over the single-step body, carry =
+    (params, buffers, opt_state, scaler_state, rng_key), xs = the K-stacked
+    batch pytree plus the K-vector of learning rates previewed from the
+    host scheduler (``LRScheduler.peek``), ys = the per-step lazy losses.
+    This amortizes per-step python dispatch/argument handling across K
+    steps (the scheduling-overhead analogue of the reference's
+    new_executor + CINN fusion) — the lever for short-step (small-model)
+    MFU.  Feed windows via ``io.StackingPrefetcher``::
+
+        step = CompiledTrainStep(model, loss_fn, opt, fused_steps=4)
+        for w in io.StackingPrefetcher(loader, k=4):
+            losses = step(*w)          # ONE dispatch, shape-[k] lazy loss
+
+    Window semantics:
+
+      * a window call returns the K-vector of losses (lazy; materializes on
+        ``.numpy()``, which syncs the whole window);
+      * ``jit.steps`` / ``optimizer._step_count`` advance by K per window;
+        ``jit.host.dispatches`` advances by 1 (the counter gate is
+        ``dispatches == steps / K`` in steady state);
+      * GradScaler skip-steps, in-graph dropout key splitting and
+        ``FLAGS_check_nan_inf`` all run per scan iteration — trajectories
+        are bit-identical to K single-step dispatches, and a nan/inf raise
+        names the offending step index inside the window;
+      * partial windows (tail of a loader whose length is not a multiple of
+        K) and the very first window (optimizer accumulators not yet
+        materialized, so the scan carry structure is unknown) fall back to
+        K single-step dispatches — no batch is dropped or padded;
+      * ``.sync()`` and the mutation barrier land on post-window values.
+
     With ``scaler`` (an enabled amp.GradScaler), fp16 dynamic loss scaling
     runs in-graph: scaled backward, traced found-inf, skipped update, scale
     adjustment — zero host round-trips (reference: amp/grad_scaler.py:619).
@@ -277,17 +309,25 @@ class CompiledTrainStep:
     of inputs to outputs remains legal.
     """
 
-    def __init__(self, model, loss_fn, optimizer, scaler=None, donate=True):
+    def __init__(self, model, loss_fn, optimizer, scaler=None, donate=True,
+                 fused_steps=None):
         import weakref
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.scaler = scaler if (scaler is not None
                                  and scaler.is_enable()) else None
+        if fused_steps is None:
+            fused_steps = int(_flags.flag("FLAGS_fused_steps"))
+        if int(fused_steps) < 1:
+            raise ValueError(f"fused_steps must be >= 1, got {fused_steps}")
+        self.fused_steps = int(fused_steps)
         # keyed by the FLAGS_check_nan_inf value the program was traced
         # under: the guard's finite-ness checks are part of the XLA program,
         # so flag-off runs execute a program with zero check overhead
         self._jits = {}
+        # fused window programs, keyed by (check_nan_inf, window length)
+        self._fused_jits = {}
         self._donate = donate
         # (params, buffers, opt_state, sstate, rng_carry) — device resident
         self._state = None
@@ -295,6 +335,8 @@ class CompiledTrainStep:
         self._synced = True
         self._lr_host = None
         self._lr_dev = None
+        self._lrs_host = None  # lr vector of the last fused window
+        self._lrs_dev = None
         # state_dict() on the model/optimizer/scaler auto-syncs through this
         model.__dict__["_train_step_owner"] = weakref.ref(self)
         optimizer.__dict__["_train_step_owner"] = weakref.ref(self)
@@ -339,121 +381,231 @@ class CompiledTrainStep:
         self.sync()
         self._state = None
 
-    def _make_jit(self, check_nan_inf=False):
+    def _step_body(self, check_nan_inf, params, buffers, opt_state, lr,
+                   rng_key, sstate, args):
+        """One training step as a pure traceable function — the body shared
+        by the single-step program and each ``lax.scan`` iteration of a
+        fused window.  Returns (loss, params', buffers', opt_state',
+        sstate', rng_carry', checks)."""
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
         scaler = self.scaler
+        from ..tensor import random as _rnd
+        _counters.inc("jit.traces")  # body runs as python only per trace
+        # save the concrete host bindings: they are restored in the
+        # finally block so tracers never leak into Parameter._data /
+        # optimizer accumulators after the trace finishes
+        saved_params = [(p, p._data) for _, p in model.named_parameters()]
+        saved_buffers = [(b, b._data) for _, b in model.named_buffers()]
+        saved_accs = opt._accumulators
+        saved_masters = opt._master_weights
+        prev_lr = opt._learning_rate
+        prev_step_count = opt._step_count
+        prev_grad_mode = STATE.grad_enabled
+        prev_chain = _rnd._TRACE_CHAIN[0]
+        use_key, carry_key = jax.random.split(rng_key)
+        _rnd._TRACE_CHAIN[0] = _rnd._TraceKeyChain(use_key)
+        STATE.tracing_depth += 1
+        try:
+            bind_layer_state(model, params, buffers)
+            bind_optimizer_state(opt, opt_state)
+            opt._learning_rate = lr
+            wargs = jax.tree_util.tree_map(
+                lambda x: Tensor._wrap(x) if isinstance(
+                    x, (jax.Array, jax.core.Tracer)) else x, args)
+            STATE.grad_enabled = True
+            loss = loss_fn(model, *wargs)
+            if scaler is not None:
+                found = _scaled_backward(model, opt, loss, lr,
+                                         sstate["scale"])
+            else:
+                loss.backward()
+            checks = {}
+            if check_nan_inf:
+                # FLAGS_check_nan_inf (reference: eager nan_inf_utils.cc
+                # hook): finite-ness of loss / per-param grads / updated
+                # params traced INTO the program; host side raises with
+                # span context.  Under a GradScaler the grads seen here
+                # are post-unscale safe values and found_inf reports the
+                # overflow the scaler already handles.
+                checks["loss"] = jnp.all(jnp.isfinite(
+                    loss._data.astype(jnp.float32)))
+                for k, p in model.named_parameters():
+                    if p.grad is not None:
+                        checks["grad:" + k] = jnp.all(jnp.isfinite(
+                            p.grad._data.astype(jnp.float32)))
+            opt.step()
+            opt.clear_grad()
+            new_params = {k: p._data for k, p in model.named_parameters()}
+            new_buffers = {k: b._data for k, b in model.named_buffers()}
+            new_opt = optimizer_state(opt)
+            if scaler is not None:
+                new_params = _skip_select(found, params, new_params)
+                new_opt = _skip_select(found, opt_state, new_opt)
+                sstate = scaler._traced_update(sstate, found)
+            if check_nan_inf:
+                for k, v in new_params.items():
+                    checks["param:" + k] = jnp.all(jnp.isfinite(
+                        v.astype(jnp.float32)))
+                if scaler is not None:
+                    checks["found_inf"] = found
+            loss_data = loss._data
+        finally:
+            STATE.tracing_depth -= 1
+            _rnd._TRACE_CHAIN[0] = prev_chain
+            opt._learning_rate = prev_lr
+            # the host step counter is owned by __call__ (one bump per
+            # step); the trace-time opt.step() bump must not stick
+            opt._step_count = prev_step_count
+            STATE.grad_enabled = prev_grad_mode
+            for p, d in saved_params:
+                p._data = d
+                p.grad = None
+            for b, d in saved_buffers:
+                b._data = d
+            opt._accumulators = saved_accs
+            opt._master_weights = saved_masters
+        return (loss_data, new_params, new_buffers, new_opt, sstate,
+                carry_key, checks)
 
+    def _donate_argnums(self):
+        # full donation including the scaler path: _skip_select consumes
+        # the pre-step values inside the program, so aliasing params/
+        # buffers/opt-state buffers to the outputs is still legal
+        return (0, 1, 2) if self._donate else ()
+
+    def _make_jit(self, check_nan_inf=False):
         def step_fn(params, buffers, opt_state, lr, rng_key, sstate, args):
-            from ..tensor import random as _rnd
-            _counters.inc("jit.traces")  # body runs as python only per trace
-            # save the concrete host bindings: they are restored in the
-            # finally block so tracers never leak into Parameter._data /
-            # optimizer accumulators after the trace finishes
-            saved_params = [(p, p._data) for _, p in model.named_parameters()]
-            saved_buffers = [(b, b._data) for _, b in model.named_buffers()]
-            saved_accs = opt._accumulators
-            saved_masters = opt._master_weights
-            prev_lr = opt._learning_rate
-            prev_step_count = opt._step_count
-            prev_grad_mode = STATE.grad_enabled
-            use_key, carry_key = jax.random.split(rng_key)
-            _rnd._TRACE_CHAIN[0] = _rnd._TraceKeyChain(use_key)
-            STATE.tracing_depth += 1
-            try:
-                bind_layer_state(model, params, buffers)
-                bind_optimizer_state(opt, opt_state)
-                opt._learning_rate = lr
-                wargs = jax.tree_util.tree_map(
-                    lambda x: Tensor._wrap(x) if isinstance(
-                        x, (jax.Array, jax.core.Tracer)) else x, args)
-                STATE.grad_enabled = True
-                loss = loss_fn(model, *wargs)
-                if scaler is not None:
-                    found = _scaled_backward(model, opt, loss, lr,
-                                             sstate["scale"])
-                else:
-                    loss.backward()
-                checks = {}
-                if check_nan_inf:
-                    # FLAGS_check_nan_inf (reference: eager nan_inf_utils.cc
-                    # hook): finite-ness of loss / per-param grads / updated
-                    # params traced INTO the program; host side raises with
-                    # span context.  Under a GradScaler the grads seen here
-                    # are post-unscale safe values and found_inf reports the
-                    # overflow the scaler already handles.
-                    checks["loss"] = jnp.all(jnp.isfinite(
-                        loss._data.astype(jnp.float32)))
-                    for k, p in model.named_parameters():
-                        if p.grad is not None:
-                            checks["grad:" + k] = jnp.all(jnp.isfinite(
-                                p.grad._data.astype(jnp.float32)))
-                opt.step()
-                opt.clear_grad()
-                new_params = {k: p._data for k, p in model.named_parameters()}
-                new_buffers = {k: b._data for k, b in model.named_buffers()}
-                new_opt = optimizer_state(opt)
-                if scaler is not None:
-                    new_params = _skip_select(found, params, new_params)
-                    new_opt = _skip_select(found, opt_state, new_opt)
-                    sstate = scaler._traced_update(sstate, found)
-                if check_nan_inf:
-                    for k, v in new_params.items():
-                        checks["param:" + k] = jnp.all(jnp.isfinite(
-                            v.astype(jnp.float32)))
-                    if scaler is not None:
-                        checks["found_inf"] = found
-                loss_data = loss._data
-            finally:
-                STATE.tracing_depth -= 1
-                _rnd._TRACE_CHAIN[0] = None
-                opt._learning_rate = prev_lr
-                # the host step counter is owned by __call__ (one bump per
-                # step); the trace-time opt.step() bump must not stick
-                opt._step_count = prev_step_count
-                STATE.grad_enabled = prev_grad_mode
-                for p, d in saved_params:
-                    p._data = d
-                    p.grad = None
-                for b, d in saved_buffers:
-                    b._data = d
-                opt._accumulators = saved_accs
-                opt._master_weights = saved_masters
-            return (loss_data, new_params, new_buffers, new_opt, sstate,
-                    carry_key, checks)
+            return self._step_body(check_nan_inf, params, buffers, opt_state,
+                                   lr, rng_key, sstate, args)
 
-        donate = ()
-        if self._donate:
-            # full donation including the scaler path: _skip_select consumes
-            # the pre-step values inside the program, so aliasing params/
-            # buffers/opt-state buffers to the outputs is still legal
-            donate = (0, 1, 2)
-        return jax.jit(step_fn, donate_argnums=donate)
+        return jax.jit(step_fn, donate_argnums=self._donate_argnums())
+
+    def _make_fused_jit(self, check_nan_inf, k):
+        """Fused window program: ``jax.lax.scan`` of the single-step body
+        over K stacked batches and a K-vector of learning rates — forward +
+        backward + optimizer update for all K steps in ONE donated XLA
+        launch.  Requires the optimizer accumulators to already exist (the
+        scan carry structure must be invariant), so the first-ever window
+        runs through the single-step fallback instead."""
+
+        def window_fn(params, buffers, opt_state, lrs, rng_key, sstate,
+                      stacked_args):
+            def body(carry, xs):
+                params, buffers, opt_state, sstate, rng_key = carry
+                lr, args = xs
+                (loss, params, buffers, opt_state, sstate, rng_key,
+                 checks) = self._step_body(check_nan_inf, params, buffers,
+                                           opt_state, lr, rng_key, sstate,
+                                           args)
+                return ((params, buffers, opt_state, sstate, rng_key),
+                        (loss, checks))
+
+            init = (params, buffers, opt_state, sstate, rng_key)
+            ((params, buffers, opt_state, sstate, rng_key),
+             (losses, checks)) = jax.lax.scan(body, init,
+                                              (lrs, stacked_args), length=k)
+            return (losses, params, buffers, opt_state, sstate, rng_key,
+                    checks)
+
+        return jax.jit(window_fn, donate_argnums=self._donate_argnums())
 
     def __call__(self, *args):
         with _trace.span("jit.step"):
+            from ..io import Window
+            if len(args) == 1 and isinstance(args[0], Window):
+                return self._call_window(tuple(args[0]), args[0].k)
+            if self.fused_steps > 1:
+                # fused mode: every call takes a K-stacked window (leading
+                # axis = window length on every array leaf)
+                return self._call_window(args, None)
             return self._call_impl(args)
 
-    def _call_impl(self, args):
+    def _ensure_state(self):
         from ..core.state import param_version
-        _counters.inc("jit.steps")
-        hydrated = False
         if self._state is None or param_version() != self._seen_version:
             self._hydrate()
-            hydrated = True
+            return True
+        return False
+
+    @staticmethod
+    def _strip(args):
+        return jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, args,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    @staticmethod
+    def _window_len(args_data):
+        for leaf in jax.tree_util.tree_leaves(args_data):
+            if hasattr(leaf, "shape") and len(leaf.shape) >= 1:
+                return int(leaf.shape[0])
+        raise ValueError(
+            "cannot infer the window length: no array leaf with a leading "
+            "axis in the window args (stack batches or pass an io.Window)")
+
+    def _call_impl(self, args):
+        hydrated = self._ensure_state()
+        loss = self._dispatch_single(self._strip(args),
+                                     self.optimizer.get_lr())
+        if hydrated:
+            # first call after (re)hydration: keep the python objects fresh
+            # so "step once, then inspect" retains eager semantics; the
+            # steady-state path skips this entirely
+            self.sync()
+        from ..distributed.elastic import heartbeat
+        heartbeat()  # no-op unless under the elastic launcher
+        return Tensor._wrap(loss)
+
+    def _call_window(self, args, k=None):
+        """Train on a window of K stacked batches: ONE fused dispatch when
+        the window is full-size and the carry structure is known, K
+        single-step dispatches otherwise (first-ever window, partial tail).
+        Returns the [k] vector of per-step lazy losses."""
+        args_data = self._strip(args)
+        if k is None:
+            k = self._window_len(args_data)
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"empty dispatch window (k={k})")
+        hydrated = self._ensure_state()
+        # per-step lr vector, previewed WITHOUT mutating the host scheduler
+        # (the scheduler advances under user control, after the window)
+        lrs = self.optimizer._peek_lrs(k)
+        # fused dispatch needs an invariant scan carry structure, so the
+        # very first window (lazy optimizer accumulators not yet
+        # materialized) runs as single steps, like any partial tail window
+        if (k == self.fused_steps and k > 1
+                and self.optimizer._step_count > 0):
+            losses = self._dispatch_window(args_data, lrs, k)
+        else:
+            with _trace.span("jit.window_fallback"):
+                _counters.inc("jit.fused_fallback_steps", k)
+                per_step = []
+                for i in range(k):
+                    sliced = jax.tree_util.tree_map(
+                        lambda x, _i=i: x[_i] if hasattr(x, "shape") else x,
+                        args_data)
+                    per_step.append(self._dispatch_single(sliced, lrs[i]))
+                losses = jnp.stack(per_step)
+        if hydrated:
+            self.sync()
+        from ..distributed.elastic import heartbeat
+        heartbeat()  # no-op unless under the elastic launcher
+        return Tensor._wrap(losses)
+
+    def _dispatch_single(self, args_data, lr_val):
+        """One single-step XLA dispatch on raw array args -> raw loss."""
+        _counters.inc("jit.steps")
         check = bool(_flags.flag("FLAGS_check_nan_inf"))
         jit_fn = self._jits.get(check)
         if jit_fn is None:
             jit_fn = self._jits[check] = self._make_jit(check)
-        args_data = jax.tree_util.tree_map(
-            lambda x: x._data if isinstance(x, Tensor) else x, args,
-            is_leaf=lambda x: isinstance(x, Tensor))
-        lr_val = self.optimizer.get_lr()
         if self._lr_dev is None or lr_val != self._lr_host:
             self._lr_host = lr_val
             self._lr_dev = jnp.asarray(lr_val, jnp.float32)
         params, buffers, opt_state, sstate, rng_key = self._state
         traces_before = _counters.get("jit.traces")
         with _trace.span("jit.dispatch"):
+            _counters.inc("jit.host.dispatches")
             (loss, new_params, new_buffers, new_opt, new_sstate,
              new_rng, checks) = jit_fn(params, buffers, opt_state,
                                        self._lr_dev, rng_key, sstate,
@@ -468,37 +620,84 @@ class CompiledTrainStep:
         self._synced = False
         if check and checks:
             self._raise_if_nonfinite(checks)
-        if hydrated:
-            # first call after (re)hydration: keep the python objects fresh
-            # so "step once, then inspect" retains eager semantics; the
-            # steady-state path skips this entirely
-            self.sync()
-        from ..distributed.elastic import heartbeat
-        heartbeat()  # no-op unless under the elastic launcher
-        return Tensor._wrap(loss)
+        return loss
 
-    def _raise_if_nonfinite(self, checks):
+    def _dispatch_window(self, args_data, lrs, k):
+        """One fused K-step XLA dispatch on K-stacked args -> raw [k]
+        losses."""
+        _counters.inc("jit.steps", k)
+        _counters.inc("jit.fused_windows")
+        check = bool(_flags.flag("FLAGS_check_nan_inf"))
+        cache_key = (check, k)
+        jit_fn = self._fused_jits.get(cache_key)
+        if jit_fn is None:
+            jit_fn = self._fused_jits[cache_key] = \
+                self._make_fused_jit(check, k)
+        lrs_t = tuple(float(v) for v in lrs)
+        if self._lrs_dev is None or lrs_t != self._lrs_host:
+            self._lrs_host = lrs_t
+            self._lrs_dev = jnp.asarray(lrs_t, jnp.float32)
+        params, buffers, opt_state, sstate, rng_key = self._state
+        traces_before = _counters.get("jit.traces")
+        with _trace.span("jit.dispatch"):
+            _counters.inc("jit.host.dispatches")
+            (losses, new_params, new_buffers, new_opt, new_sstate,
+             new_rng, checks) = jit_fn(params, buffers, opt_state,
+                                       self._lrs_dev, rng_key, sstate,
+                                       args_data)
+        _counters.inc("jit.cache_hits"
+                      if _counters.get("jit.traces") == traces_before
+                      else "jit.cache_misses")
+        self.optimizer._step_count += k
+        self._state = (new_params, new_buffers, new_opt, new_sstate, new_rng)
+        self._synced = False
+        if check and checks:
+            self._raise_if_nonfinite(checks, window=k)
+        return losses
+
+    def _raise_if_nonfinite(self, checks, window=1):
         """FLAGS_check_nan_inf host side: pull the traced finite-ness bits
         (a deliberate host sync — this is a debug mode) and raise with the
-        offending phase names and the current span context."""
+        offending phase names, the step index inside a fused window, and
+        the current span context."""
+        import numpy as np
         with _trace.span("jit.nan_inf_check"):
             _counters.inc("jit.nan_inf_checks")
-            bad = sorted(k for k, v in checks.items()
-                         if k != "found_inf" and not bool(v))
-            if not bad:
-                return
-            if self.scaler is not None and bool(checks.get("found_inf")):
-                # fp16 overflow step: the scaler skipped the update and will
-                # shrink the scale — expected dynamics, not a defect
+            finfo = checks.get("found_inf")
+            overflow = (np.atleast_1d(np.asarray(finfo))
+                        if (self.scaler is not None and finfo is not None)
+                        else None)
+            bad_by_step = {}
+            for name in sorted(checks):
+                if name == "found_inf":
+                    continue
+                arr = np.atleast_1d(np.asarray(checks[name]))
+                for i, ok in enumerate(arr):
+                    if bool(ok):
+                        continue
+                    if overflow is not None and bool(
+                            overflow[i if overflow.size > 1 else 0]):
+                        # fp16 overflow step: the scaler skipped the update
+                        # and will shrink the scale — expected dynamics,
+                        # not a defect
+                        continue
+                    bad_by_step.setdefault(i, []).append(name)
+            if not bad_by_step:
                 return
             _counters.inc("jit.nan_inf_hits")
+            i = min(bad_by_step)
+            bad = bad_by_step[i]
             shown = ", ".join(bad[:8]) + (f" (+{len(bad) - 8} more)"
                                           if len(bad) > 8 else "")
+            gstep = self.optimizer._step_count - window + i + 1
+            where = (f"train step {gstep} (step {i} of a {window}-step "
+                     f"fused window)" if window > 1
+                     else f"train step {gstep}")
             stack = _trace.current_stack()
             ctx = f" [active spans: {' > '.join(stack)}]" if stack else ""
             raise FloatingPointError(
-                f"FLAGS_check_nan_inf: non-finite values at train step "
-                f"{self.optimizer._step_count}: {shown}{ctx}")
+                f"FLAGS_check_nan_inf: non-finite values at {where}: "
+                f"{shown}{ctx}")
 
 
 import contextlib

@@ -61,6 +61,14 @@ class Optimizer:
     def set_lr(self, value):
         self._learning_rate = value
 
+    def _peek_lrs(self, k):
+        """Per-step lr values (host floats) for the next ``k`` steps, read
+        without mutating scheduler state — the xs lr-vector of a fused
+        dispatch window (LRScheduler.peek); constant lr broadcasts."""
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate.peek(k)
+        return [float(self._learning_rate)] * int(k)
+
     def set_lr_scheduler(self, scheduler):
         self._learning_rate = scheduler
 

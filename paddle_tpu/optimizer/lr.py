@@ -26,6 +26,30 @@ class LRScheduler:
     def get_lr(self):
         raise NotImplementedError
 
+    def peek(self, k):
+        """Preview the lr values the next ``k`` training steps would use,
+        WITHOUT mutating scheduler state.
+
+        ``peek(k)[0]`` is the current lr (what ``__call__`` returns now) and
+        ``peek(k)[i]`` is the value after ``i`` further ``step()`` calls —
+        the per-step lr vector a fused K-step dispatch window feeds to its
+        ``lax.scan`` (jit.CompiledTrainStep ``fused_steps``).  The preview
+        runs on a deep copy, so schedulers whose ``get_lr`` itself mutates
+        state (e.g. LinearWarmup stepping its wrapped scheduler) stay
+        untouched; metric-driven schedulers (ReduceOnPlateau) preview as
+        constant because future metrics are unknowable.
+        """
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"peek(k) needs k >= 1, got {k}")
+        import copy
+        probe = copy.deepcopy(self)
+        vals = [float(probe.last_lr)]
+        for _ in range(k - 1):
+            probe.step()
+            vals.append(float(probe.last_lr))
+        return vals
+
     def state_dict(self):
         return {"last_epoch": self.last_epoch, "last_lr": self.last_lr}
 

@@ -97,7 +97,12 @@ def export_chrome_tracing(dir_name, worker_name=None):
 class _StepTimer:
     """timer_only benchmarking: per-step wall latency, ips, and the
     reader-vs-batch cost split (reader cost = movement of the io.* wait
-    counters during the step, i.e. time the step spent blocked on data)."""
+    counters during the step, i.e. time the step spent blocked on data).
+
+    Under fused multi-step dispatch (jit.CompiledTrainStep
+    ``fused_steps=K``) call ``prof.step()`` once per window: one "step" is
+    then one K-step XLA launch, so batch_cost / ips are per-window —
+    divide/multiply by K for per-training-step numbers."""
 
     _READER_KEYS = ("io.reader_ns", "io.prefetch_stall_ns",
                     "io.queue_wait_ns")

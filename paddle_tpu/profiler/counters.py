@@ -12,10 +12,13 @@ Well-known names (see README "Observability" for the full table):
 
   jit.steps / jit.traces / jit.cache_hits / jit.cache_misses
   jit.hydrates / jit.syncs
+  jit.host.dispatches (XLA launches: steps/K under fused_steps=K)
+  jit.fused_windows / jit.fused_fallback_steps
   jit.host.layer_state / jit.host.bind_layer_state /
   jit.host.optimizer_state / jit.host.bind_optimizer_state
   static.runs / static.compiles / static.traces
   io.device_put_calls / io.device_put_bytes
+  io.stack_windows / io.stack_batches
   io.reader_ns / io.prefetch_stall_ns / io.queue_wait_ns
   dist.collectives / dist.<op> / dist.mp_collectives
   optimizer.steps

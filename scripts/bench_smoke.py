@@ -7,6 +7,11 @@ process-global ``paddle_tpu.profiler.counters`` registry (jit.host.* keys;
 must additionally be a pure cache hit: zero retraces (``jit.traces``).
 Prints one JSON line; raises on violation.
 
+A fused-dispatch phase re-runs the same model with ``fused_steps=K`` and
+gates the launch economics: a steady K-step window must be exactly ONE
+XLA dispatch (``jit.host.dispatches == jit.steps / K``) with zero
+retraces.
+
 Run directly (``python scripts/bench_smoke.py``), via ``PTPU_BENCH_SMOKE=1
 python bench.py``, or through tests/test_train_step_state.py (tier-1).
 """
@@ -50,15 +55,42 @@ def run():
     host_delta = {k: steady.get(k, 0) for k in host_keys}
     step3 = counters.delta(mid, after)
 
+    # ---- fused multi-step dispatch: one launch per K-step window --------
+    from paddle_tpu.io import Window
+    fused_k = 2
+    paddle.seed(0)
+    fmodel = GPTForCausalLM(cfg)
+    fopt = paddle.optimizer.AdamW(1e-4, parameters=fmodel.parameters())
+    fstep = pjit.CompiledTrainStep(fmodel, loss_fn, fopt,
+                                   fused_steps=fused_k)
+    wids = paddle.to_tensor(np.stack([np.asarray(ids.numpy())] * fused_k))
+    wlabels = paddle.to_tensor(np.stack([np.asarray(labels.numpy())]
+                                        * fused_k))
+    win = Window((wids, wlabels), fused_k)
+    fstep(win).numpy()   # window 1: priming single-step fallback
+    fstep(win).numpy()   # window 2: scan compile
+    fbefore = counters.snapshot()
+    flosses = [round(float(l), 6)
+               for l in np.asarray(fstep(win).numpy())]  # steady window
+    fused = counters.delta(fbefore)
+    fused_dispatches = fused.get("jit.host.dispatches", 0)
+    fused_steps_done = fused.get("jit.steps", 0)
+
     result = {"metric": "steady_state_host_syncs",
               "value": sum(host_delta.values()),
               "unit": "calls/2 steps",
               "delta": host_delta,
               "step3_retraces": step3.get("jit.traces", 0),
+              "steady_dispatches": steady.get("jit.host.dispatches", 0),
               "counters": {k: v for k, v in steady.items()
                            if k.startswith(("jit.", "io.", "dist.",
                                             "optimizer."))},
-              "losses": [round(l, 6) for l in losses]}
+              "losses": [round(l, 6) for l in losses],
+              "fused_k": fused_k,
+              "fused_window_dispatches": fused_dispatches,
+              "fused_window_steps": fused_steps_done,
+              "fused_window_retraces": fused.get("jit.traces", 0),
+              "fused_losses": flosses}
     print(json.dumps(result))
     if sum(host_delta.values()) != 0:
         raise AssertionError(
@@ -68,8 +100,23 @@ def run():
             f"step 3 retraced: jit.traces += {result['step3_retraces']} "
             "(expected a pure jit cache hit after the step-2 "
             "accumulator-structure retrace)")
-    if not all(np.isfinite(l) for l in losses):
-        raise AssertionError(f"non-finite loss in smoke run: {losses}")
+    if result["steady_dispatches"] != 2:
+        raise AssertionError(
+            "steady-state single-step mode must be exactly 1 XLA dispatch "
+            f"per step: jit.host.dispatches += {result['steady_dispatches']} "
+            "over 2 steps")
+    if fused_steps_done != fused_k or fused_dispatches != 1:
+        raise AssertionError(
+            "fused dispatch economics violated: a steady fused window must "
+            f"be jit.steps / K == {fused_steps_done} / {fused_k} == 1 XLA "
+            f"dispatch, got jit.host.dispatches += {fused_dispatches}")
+    if result["fused_window_retraces"] != 0:
+        raise AssertionError(
+            "steady fused window retraced: jit.traces += "
+            f"{result['fused_window_retraces']}")
+    if not all(np.isfinite(l) for l in losses + flosses):
+        raise AssertionError(
+            f"non-finite loss in smoke run: {losses} / {flosses}")
     return result
 
 

@@ -11,6 +11,12 @@ measured steps which must show:
   * 0 host bind/sync work (jit.host.*, jit.syncs)
   * 2 cache hits, 0 misses (every dispatch is a pure jit-cache hit)
 
+A second phase gates the fused multi-step dispatch path
+(``fused_steps=K``): after its warmup (window 1 = priming single-step
+fallback, window 2 = scan compile), every measured K-step window must be
+exactly ONE XLA dispatch — ``jit.host.dispatches == jit.steps / K`` —
+again with zero retraces / rehydrates / host binds.
+
 Prints one JSON line; raises AssertionError on any violation.  Wired as a
 tier-1 test via tests/test_profiler.py.  Run directly:
 ``python scripts/check_counters.py``.
@@ -21,6 +27,8 @@ import os
 
 WARMUP = 2
 MEASURE = 2
+FUSED_K = 2
+FUSED_MEASURE = 2  # measured windows = FUSED_MEASURE * FUSED_K steps
 
 
 def run():
@@ -54,18 +62,61 @@ def run():
         "jit.cache_misses": 0,
         "jit.cache_hits": MEASURE,
         "jit.steps": MEASURE,
+        "jit.host.dispatches": MEASURE,  # single-step mode: 1 launch/step
     }
     invariants.update({"jit.host." + k: 0 for k in pjit._HOST_SYNC_KEYS})
 
     violations = {k: (steady.get(k, 0), want)
                   for k, want in invariants.items()
                   if steady.get(k, 0) != want}
+
+    # ---- fused multi-step dispatch gate: dispatches == steps / K --------
+    from paddle_tpu.io import Window
+
+    paddle.seed(0)
+    fmodel = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+    fopt = paddle.optimizer.AdamW(1e-3, parameters=fmodel.parameters())
+    fstep = pjit.CompiledTrainStep(fmodel, loss_fn, fopt,
+                                   fused_steps=FUSED_K)
+    import numpy as np
+    rng = np.random.RandomState(0)
+    def window():
+        return Window(
+            (paddle.to_tensor(rng.randn(FUSED_K, 8, 16).astype("float32")),
+             paddle.to_tensor(rng.randn(FUSED_K, 8, 4).astype("float32"))),
+            FUSED_K)
+    fstep(window()).numpy()  # window 1: priming single-step fallback
+    fstep(window()).numpy()  # window 2: scan compile
+    fbefore = counters.snapshot()
+    for _ in range(FUSED_MEASURE):
+        fstep(window()).numpy()
+    fsteady = counters.delta(fbefore)
+
+    finvariants = {
+        "jit.traces": 0,
+        "jit.hydrates": 0,
+        "jit.syncs": 0,
+        "jit.cache_misses": 0,
+        "jit.cache_hits": FUSED_MEASURE,
+        "jit.steps": FUSED_MEASURE * FUSED_K,
+        "jit.fused_windows": FUSED_MEASURE,
+        "jit.fused_fallback_steps": 0,
+        # THE fused-dispatch economics gate: one launch per K-step window
+        "jit.host.dispatches": (FUSED_MEASURE * FUSED_K) // FUSED_K,
+    }
+    finvariants.update({"jit.host." + k: 0 for k in pjit._HOST_SYNC_KEYS})
+    violations.update({f"fused:{k}": (fsteady.get(k, 0), want)
+                       for k, want in finvariants.items()
+                       if fsteady.get(k, 0) != want})
+
     result = {"metric": "steady_state_counter_violations",
               "value": len(violations),
-              "unit": f"violations/{MEASURE} steps",
+              "unit": f"violations/{MEASURE} steps "
+                      f"+ {FUSED_MEASURE} fused windows",
               "violations": {k: {"got": got, "want": want}
                              for k, (got, want) in violations.items()},
-              "steady_delta": steady}
+              "steady_delta": steady,
+              "fused_steady_delta": fsteady}
     print(json.dumps(result))
     if violations:
         raise AssertionError(

@@ -1,0 +1,355 @@
+"""Fused multi-step dispatch: CompiledTrainStep(fused_steps=K) contract.
+
+The fused path scans K training steps inside ONE donated XLA program
+(one ``jax.lax.scan`` over the shared step body).  The contract it must
+keep:
+
+  * bit-identity — a K=4 fused run produces the exact bits of a K=1 run:
+    losses, parameters, optimizer state, GradScaler trajectory (including
+    an inf-grad skip-step landing INSIDE a fused window), and an lr
+    schedule advancing across the window;
+  * dispatch economics — a steady-state window is exactly one
+    ``jit.host.dispatches`` with zero retraces / rehydrates; the
+    first-ever window and partial tail windows fall back to single-step
+    dispatch (counter ``jit.fused_fallback_steps``), never drop batches;
+  * satellites — ``LRScheduler.peek(k)`` previews without mutating, and
+    ``io.StackingPrefetcher`` stacks loader batches into ``io.Window``s
+    bit-identically, flushing partial windows on tail/shape breaks.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as pjit
+import paddle_tpu.nn as nn
+from paddle_tpu.core import flags as cflags
+from paddle_tpu.io import StackingPrefetcher, Window
+from paddle_tpu.optimizer import lr as lrsched
+from paddle_tpu.profiler import counters
+
+K = 4
+
+
+def _mse(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+def _make(fused_steps, lr=1e-2, scaler=None, dtype=None, opt_cls=None,
+          seed=0):
+    paddle.seed(seed)
+    net = nn.Linear(8, 4)
+    if dtype is not None:
+        net.to(dtype=dtype)
+    opt_cls = opt_cls or paddle.optimizer.Adam
+    opt = opt_cls(parameters=net.parameters(), learning_rate=lr)
+    step = pjit.CompiledTrainStep(net, _mse, opt, scaler=scaler,
+                                  fused_steps=fused_steps)
+    return net, opt, step
+
+
+def _batches(n, seed=1, dtype="float32", poison=None):
+    rng = np.random.RandomState(seed)
+    xs = [rng.randn(16, 8).astype(dtype) for _ in range(n)]
+    ys = [rng.randn(16, 4).astype(dtype) for _ in range(n)]
+    if poison is not None:
+        xs[poison] = (np.full((16, 8), 60000.0)
+                      if dtype == "float16" else np.full((16, 8), np.inf)
+                      ).astype(dtype)
+    return xs, ys
+
+
+def _window(xs, ys, lo, hi):
+    return Window((paddle.to_tensor(np.stack(xs[lo:hi])),
+                   paddle.to_tensor(np.stack(ys[lo:hi]))), hi - lo)
+
+
+def _run_single(step, xs, ys, scheduler=None):
+    losses = []
+    for x, y in zip(xs, ys):
+        losses.append(float(step(paddle.to_tensor(x),
+                                 paddle.to_tensor(y)).numpy()))
+        if scheduler is not None:
+            scheduler.step()
+    step.sync()
+    return np.array(losses, np.float32)
+
+
+def _run_windows(step, xs, ys, k=K, scheduler=None):
+    losses = []
+    for lo in range(0, len(xs), k):
+        w = _window(xs, ys, lo, min(lo + k, len(xs)))
+        losses.extend(np.asarray(step(w).numpy()).tolist())
+        if scheduler is not None:
+            for _ in range(w.k):
+                scheduler.step()
+    step.sync()
+    return np.array(losses, np.float32)
+
+
+class TestFusedBitIdentity:
+    def test_k4_matches_k1_exactly(self):
+        xs, ys = _batches(2 * K)
+        n1, o1, s1 = _make(fused_steps=1)
+        l1 = _run_single(s1, xs, ys)
+        n4, o4, s4 = _make(fused_steps=K)
+        l4 = _run_windows(s4, xs, ys)
+        assert np.array_equal(l1, l4)
+        assert np.array_equal(np.asarray(n1.weight._data),
+                              np.asarray(n4.weight._data))
+        assert np.array_equal(np.asarray(n1.bias._data),
+                              np.asarray(n4.bias._data))
+        assert o1._step_count == o4._step_count == 2 * K
+
+    def test_scaler_skip_step_inside_fused_window(self):
+        # overflow batch at global step 6 == index 1 of fused window 2:
+        # the skip + scale shrink must happen INSIDE the scanned program
+        # and leave the exact same scaler/param trajectory as K=1
+        xs, ys = _batches(2 * K, dtype="float16", poison=5)
+
+        def mk(k):
+            paddle.seed(0)
+            net = nn.Linear(8, 4)
+            net.to(dtype="float16")
+            scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 15,
+                                           incr_every_n_steps=2)
+            opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                        learning_rate=1e-2)
+            step = pjit.CompiledTrainStep(net, _mse, opt, scaler=scaler,
+                                          fused_steps=k)
+            return net, scaler, step
+
+        n1, sc1, s1 = mk(1)
+        l1 = _run_single(s1, xs, ys)
+        n4, sc4, s4 = mk(K)
+        l4 = _run_windows(s4, xs, ys)
+        # the overflow step's loss is inf in both runs, at the same index
+        assert np.array_equal(np.isfinite(l1), np.isfinite(l4))
+        assert np.array_equal(l1[np.isfinite(l1)], l4[np.isfinite(l4)])
+        assert np.array_equal(np.asarray(n1.weight._data, np.float32),
+                              np.asarray(n4.weight._data, np.float32))
+        assert float(sc1._scale) == float(sc4._scale)
+        assert (sc1._good_steps, sc1._bad_steps) == \
+               (sc4._good_steps, sc4._bad_steps)
+
+    def test_lr_schedule_advances_inside_window(self):
+        # decay boundary at step 3 lands inside the first fused window's
+        # successor: the scan's lr xs-vector must track what a K=1 run
+        # stepping the scheduler after every step would use
+        xs, ys = _batches(2 * K)
+
+        def mk(k):
+            sched = lrsched.StepDecay(learning_rate=0.1, step_size=3,
+                                      gamma=0.5)
+            net, opt, step = _make(fused_steps=k, lr=sched)
+            return net, opt, step, sched
+
+        n1, _, s1, sched1 = mk(1)
+        l1 = _run_single(s1, xs, ys, scheduler=sched1)
+        n4, _, s4, sched4 = mk(K)
+        l4 = _run_windows(s4, xs, ys, scheduler=sched4)
+        assert np.array_equal(l1, l4)
+        assert np.array_equal(np.asarray(n1.weight._data),
+                              np.asarray(n4.weight._data))
+        assert sched1.last_lr == sched4.last_lr
+
+    def test_window_on_unfused_step_runs_as_singles(self):
+        # a Window handed to a fused_steps=1 step is serviced (fallback
+        # loop), bit-identical to calling the step per batch
+        xs, ys = _batches(K)
+        _, _, s1 = _make(fused_steps=1)
+        ref = _run_single(s1, xs, ys)
+        _, _, sw = _make(fused_steps=1)
+        got = np.asarray(sw(_window(xs, ys, 0, K)).numpy())
+        assert got.shape == (K,)
+        assert np.array_equal(ref, got.astype(np.float32))
+
+
+class TestFusedDispatchEconomics:
+    def test_priming_window_falls_back_to_singles(self):
+        xs, ys = _batches(K)
+        _, _, step = _make(fused_steps=K)
+        before = counters.snapshot()
+        step(_window(xs, ys, 0, K))
+        d = counters.delta(before)
+        assert d.get("jit.fused_fallback_steps") == K
+        assert d.get("jit.host.dispatches") == K
+        assert d.get("jit.steps") == K
+        assert not d.get("jit.fused_windows")
+
+    def test_steady_window_is_one_dispatch_zero_retrace(self):
+        xs, ys = _batches(3 * K, seed=3)
+        _, _, step = _make(fused_steps=K)
+        step(_window(xs, ys, 0, K))            # priming (fallback singles)
+        step(_window(xs, ys, K, 2 * K)).numpy()  # scan compile
+        before = counters.snapshot()
+        step(_window(xs, ys, 2 * K, 3 * K)).numpy()  # steady state
+        d = counters.delta(before)
+        assert d.get("jit.host.dispatches") == 1
+        assert d.get("jit.steps") == K
+        assert d.get("jit.fused_windows") == 1
+        assert d.get("jit.cache_hits") == 1
+        assert not d.get("jit.traces")
+        assert not d.get("jit.hydrates")
+        assert not d.get("jit.cache_misses")
+        assert not d.get("jit.host.param_binds")
+
+    def test_partial_tail_window_single_step_fallback(self):
+        n = 2 * K + 3  # tail of 3 < K
+        xs, ys = _batches(n, seed=4)
+        _, _, step = _make(fused_steps=K)
+        step(_window(xs, ys, 0, K))
+        step(_window(xs, ys, K, 2 * K))
+        before = counters.snapshot()
+        tail = step(_window(xs, ys, 2 * K, n))
+        d = counters.delta(before)
+        assert np.asarray(tail.numpy()).shape == (3,)
+        assert d.get("jit.fused_fallback_steps") == 3
+        assert d.get("jit.host.dispatches") == 3
+        assert d.get("jit.steps") == 3
+
+    def test_raw_stacked_args_infer_window_length(self):
+        # fused mode accepts bare K-stacked tensors (no Window wrapper)
+        xs, ys = _batches(K, seed=5)
+        _, _, step = _make(fused_steps=K)
+        out = step(paddle.to_tensor(np.stack(xs)),
+                   paddle.to_tensor(np.stack(ys)))
+        assert np.asarray(out.numpy()).shape == (K,)
+
+    def test_check_nan_inf_names_step_inside_window(self):
+        xs, ys = _batches(2 * K, seed=6)
+        xs[K + 2] = np.full((16, 8), np.inf, np.float32)  # window 2, idx 2
+        _, _, step = _make(fused_steps=K,
+                           opt_cls=paddle.optimizer.SGD)
+        step(_window(xs, ys, 0, K))  # prime (clean)
+        cflags.set_flags({"FLAGS_check_nan_inf": 1})
+        try:
+            with pytest.raises(FloatingPointError,
+                               match=r"FLAGS_check_nan_inf: non-finite "
+                                     r".*train step 7 \(step 2 of a "
+                                     r"4-step fused window\)"):
+                step(_window(xs, ys, K, 2 * K))
+        finally:
+            cflags.set_flags({"FLAGS_check_nan_inf": 0})
+
+
+class TestLRSchedulerPeek:
+    def test_peek_matches_stepping(self):
+        sched = lrsched.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+        preview = sched.peek(6)
+        vals = [float(sched.last_lr)]
+        for _ in range(5):
+            sched.step()
+            vals.append(float(sched.last_lr))
+        assert preview == vals
+        assert preview == [0.1, 0.1, 0.05, 0.05, 0.025, 0.025]
+
+    def test_peek_does_not_mutate(self):
+        sched = lrsched.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+        sched.step()
+        before = (sched.last_epoch, sched.last_lr)
+        first = sched.peek(5)
+        assert (sched.last_epoch, sched.last_lr) == before
+        assert sched.peek(5) == first  # idempotent
+
+    def test_peek_linear_warmup_nested_scheduler_untouched(self):
+        # LinearWarmup.get_lr steps its WRAPPED scheduler — the deepcopy
+        # probe must keep both layers of state untouched
+        inner = lrsched.StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+        sched = lrsched.LinearWarmup(learning_rate=inner, warmup_steps=3,
+                                     start_lr=0.0, end_lr=0.1)
+        inner_before = (inner.last_epoch, inner.last_lr)
+        preview = sched.peek(6)
+        assert (inner.last_epoch, inner.last_lr) == inner_before
+        vals = [float(sched.last_lr)]
+        for _ in range(5):
+            sched.step()
+            vals.append(float(sched.last_lr))
+        assert preview == pytest.approx(vals)
+
+    def test_peek_validates_k(self):
+        sched = lrsched.StepDecay(learning_rate=0.1, step_size=2)
+        with pytest.raises(ValueError):
+            sched.peek(0)
+
+    def test_optimizer_peek_constant_lr_broadcasts(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                                   learning_rate=0.25)
+        assert opt._peek_lrs(3) == [0.25, 0.25, 0.25]
+
+
+class TestStackingPrefetcher:
+    def _loader(self, n, batch=8, seed=7, last_batch=None):
+        rng = np.random.RandomState(seed)
+        batches = [(rng.randn(batch, 8).astype("float32"),
+                    rng.randn(batch, 4).astype("float32"))
+                   for _ in range(n)]
+        if last_batch is not None:
+            batches.append(last_batch)
+        return [(paddle.to_tensor(x), paddle.to_tensor(y))
+                for x, y in batches]
+
+    def test_full_windows_bit_identical(self):
+        data = self._loader(2 * K)
+        wins = list(StackingPrefetcher(data, k=K))
+        assert [w.k for w in wins] == [K, K]
+        assert len(StackingPrefetcher(data, k=K)) == 2
+        for wi, w in enumerate(wins):
+            assert isinstance(w, Window) and len(w) == 2
+            xs = np.stack([np.asarray(b[0].numpy())
+                           for b in data[wi * K:(wi + 1) * K]])
+            assert np.array_equal(np.asarray(w[0].numpy()), xs)
+
+    def test_partial_tail_window_not_dropped(self):
+        data = self._loader(K + 2)
+        wins = list(StackingPrefetcher(data, k=K))
+        assert [w.k for w in wins] == [K, 2]
+        tail = np.stack([np.asarray(b[0].numpy()) for b in data[K:]])
+        assert np.array_equal(np.asarray(wins[1][0].numpy()), tail)
+        assert len(StackingPrefetcher(data, k=K)) == 2
+
+    def test_shape_break_flushes_partial_window(self):
+        # a drop_last=False remainder batch (smaller leading dim) cannot
+        # stack with its window-mates: flush, then window it alone
+        rng = np.random.RandomState(8)
+        small = (rng.randn(3, 8).astype("float32"),
+                 rng.randn(3, 4).astype("float32"))
+        data = self._loader(K + 1, last_batch=small)
+        wins = list(StackingPrefetcher(data, k=K))
+        assert [w.k for w in wins] == [K, 1, 1]
+        assert np.asarray(wins[2][0].numpy()).shape == (1, 3, 8)
+
+    def test_counters(self):
+        data = self._loader(K + 1)
+        before = counters.snapshot()
+        list(StackingPrefetcher(data, k=K))
+        d = counters.delta(before)
+        assert d.get("io.stack_windows") == 2
+        assert d.get("io.stack_batches") == K + 1
+
+    def test_feeds_fused_step_bit_identically(self):
+        data = self._loader(2 * K, seed=9)
+        _, _, s1 = _make(fused_steps=1)
+        ref = []
+        for x, y in data:
+            ref.append(float(s1(x, y).numpy()))
+        _, _, s4 = _make(fused_steps=K)
+        got = []
+        for w in StackingPrefetcher(data, k=K):
+            got.extend(np.asarray(s4(*w).numpy()).tolist())
+        assert np.array_equal(np.array(ref, np.float32),
+                              np.array(got, np.float32))
+
+
+class TestFlagDefault:
+    def test_fused_steps_flag_seeds_constructor(self):
+        cflags.set_flags({"FLAGS_fused_steps": 3})
+        try:
+            _, _, step = _make(fused_steps=None)
+            assert step.fused_steps == 3
+        finally:
+            cflags.set_flags({"FLAGS_fused_steps": 1})
+        _, _, step = _make(fused_steps=None)
+        assert step.fused_steps == 1
