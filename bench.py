@@ -19,8 +19,13 @@ Legs (perf round 5):
   launch per K steps) — the reported ``fused_speedup`` is the
   dispatch-amortisation win on the leg most exposed to per-step python
   overhead.
-Set PTPU_BENCH=125m|760m to run a single leg.  PTPU_FUSED_STEPS sets the
-fused window length K (default 4; 1 disables the fused leg).
+- gpt125m_serve (serving leg): 8 staggered mixed-length requests through
+  ``serving.LLMEngine`` (continuous batching over the KV slot arena) vs
+  the same requests run sequentially through ``GPT.generate`` — reports
+  decode tokens/s for both and ``serve_speedup``, and asserts the engine
+  output is token-identical to the sequential path.
+Set PTPU_BENCH=125m|760m|serve to run a single leg.  PTPU_FUSED_STEPS
+sets the fused window length K (default 4; 1 disables the fused leg).
 """
 
 import json
@@ -89,6 +94,77 @@ def _run_leg(cfg, batch, seq, iters, rounds, fused_steps=1):
     return tokens_per_sec, spread, n_params, phases
 
 
+def _run_serve_leg(cfg, n_requests=8, max_new=64, max_slots=8,
+                   min_bucket=8, seed=0):
+    """Continuous-batching serving vs sequential generate on the same
+    staggered mixed-length request set.  Both paths are timed warm (all
+    programs compiled); the engine run is two waves so late arrivals
+    really do join slots mid-decode.  Returns the leg dict."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.profiler import counters
+    from paddle_tpu.serving import LLMEngine
+
+    paddle.seed(seed)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(seed)
+    S = cfg.max_seq_len
+    lens = [int(rng.randint(max(2, S // 16), S - max_new))
+            for _ in range(n_requests)]
+    prompts = [rng.randint(0, cfg.vocab_size, size=n).tolist()
+               for n in lens]
+
+    def seq_pass():
+        return [np.asarray(model.generate(
+            paddle.to_tensor(np.asarray([p])),
+            max_new_tokens=max_new).numpy())[0] for p in prompts]
+    seq_pass()  # warm: one compiled generate program per prompt length
+    t0 = time.perf_counter()
+    seq_outs = seq_pass()
+    seq_s = time.perf_counter() - t0
+
+    eng = LLMEngine(model, max_slots=max_slots, max_seq_len=S,
+                    min_bucket=min_bucket)
+    for _ in eng.generate(prompts, max_new_tokens=max_new):
+        pass  # warm: bucketed prefill/insert programs + decode program
+    before = counters.snapshot()
+    t0 = time.perf_counter()
+    half = n_requests // 2
+    hs = [eng.add_request(p, max_new_tokens=max_new)
+          for p in prompts[:half]]
+    for _ in range(3):
+        eng.step()  # wave 1 decodes; wave 2 arrives mid-flight
+    hs += [eng.add_request(p, max_new_tokens=max_new)
+           for p in prompts[half:]]
+    while not all(h.is_finished for h in hs):
+        eng.step()
+    serve_s = time.perf_counter() - t0
+    delta = counters.delta(before)
+
+    match = all(np.array_equal(h.output_ids(), s)
+                for h, s in zip(hs, seq_outs))
+    decode_tokens = n_requests * max_new
+    serve_tps = decode_tokens / max(serve_s, 1e-9)
+    seq_tps = decode_tokens / max(seq_s, 1e-9)
+    leg = {"requests": n_requests,
+           "max_new_tokens": max_new,
+           "max_slots": max_slots,
+           "prompt_lens": lens,
+           "decode_tokens_per_sec": round(serve_tps, 2),
+           "sequential_tokens_per_sec": round(seq_tps, 2),
+           "serve_speedup": round(serve_tps / max(seq_tps, 1e-9), 4),
+           "outputs_match_generate": match,
+           "steady_retraces": delta.get("serving.retraces", 0),
+           "prefill_programs": eng.stats()["prefill_programs"]}
+    if not match:
+        raise AssertionError(
+            "serving leg: engine output diverged from sequential "
+            "GPT.generate")
+    del eng, model
+    return leg
+
+
 def main():
     if os.environ.get("PTPU_BENCH_SMOKE") == "1":
         # perf-contract smoke leg: asserts steady-state steps do zero
@@ -126,12 +202,17 @@ def main():
                             "tokens_per_sec": round(ftps, 2),
                             "fused_speedup": round(ftps / tps, 4),
                             "phases": fphases}
+        # tiny serving leg: correctness gate (token identity) always; the
+        # speedup number is informational on CPU
+        out["serve"] = _run_serve_leg(cfg, n_requests=8, max_new=8,
+                                      max_slots=4, min_bucket=4)
         print(json.dumps(out))
         return
 
     which = os.environ.get("PTPU_BENCH", "all")
-    if which not in ("all", "760m", "125m"):
-        raise SystemExit(f"PTPU_BENCH={which!r}: expected all|760m|125m")
+    if which not in ("all", "760m", "125m", "serve"):
+        raise SystemExit(
+            f"PTPU_BENCH={which!r}: expected all|760m|125m|serve")
     legs = {}
     if which in ("all", "760m"):
         cfg = GPTConfig.gpt3_760m(vocab_size=50304, max_seq_len=1024,
@@ -168,8 +249,29 @@ def main():
                 "fused_speedup": round(ftps / tps, 4),
                 "spread_frac": round(fspread, 4),
                 "phases": fphases}
+    if which in ("all", "serve"):
+        # serving leg: continuous batching vs sequential generate on 8
+        # staggered mixed-length requests (acceptance: serve_speedup > 1
+        # on TPU, outputs token-identical always)
+        scfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
+                                   dtype="bfloat16",
+                                   use_flash_attention=False,
+                                   recompute=None)
+        legs["gpt125m_serve"] = _run_serve_leg(scfg, n_requests=8,
+                                               max_new=64, max_slots=8)
 
-    flag = "gpt760m" if "gpt760m" in legs else "gpt125m"
+    flag = ("gpt760m" if "gpt760m" in legs
+            else "gpt125m" if "gpt125m" in legs else "gpt125m_serve")
+    if flag == "gpt125m_serve":  # serve-only run: decode throughput line
+        leg = legs[flag]
+        print(json.dumps({
+            "metric": "gpt125m_serve_decode_tokens_per_sec",
+            "value": leg["decode_tokens_per_sec"],
+            "unit": "tokens/s",
+            "vs_baseline": leg["serve_speedup"],  # vs sequential generate
+            "legs": legs,
+        }))
+        return
     print(json.dumps({
         "metric": f"{flag}_train_tokens_per_sec_per_chip",
         "value": legs[flag]["tokens_per_sec"],

@@ -140,3 +140,48 @@ class Predictor:
 def create_predictor(config: Config):
     """reference: paddle_infer::CreatePredictor."""
     return Predictor(config)
+
+
+class GenerationPredictor:
+    """Deployment front end for causal-LM generation that routes every
+    request through ``serving.LLMEngine`` (continuous batching over a
+    device-resident KV slot arena) instead of one ``GPT.generate`` program
+    per request shape.
+
+    reference analogue: the inference-deployment generation path
+    (fused_multi_transformer serving); here the engine owns admission,
+    batching, sampling, and eviction — the predictor is a thin façade:
+
+        pred = inference.GenerationPredictor(model, max_slots=8)
+        outs = pred.generate(prompts, max_new_tokens=64)   # blocking batch
+        for tok in pred.stream(prompt, max_new_tokens=64): # token stream
+            ...
+    """
+
+    def __init__(self, model, max_slots=8, max_seq_len=None, **engine_kw):
+        from ..serving import LLMEngine
+        self._engine = LLMEngine(model, max_slots=max_slots,
+                                 max_seq_len=max_seq_len, **engine_kw)
+
+    @property
+    def engine(self):
+        return self._engine
+
+    def generate(self, prompts, **kw):
+        """Blocking batch generation: list of prompts in, list of full
+        np.int32 sequences (prompt + generated) out."""
+        return self._engine.generate(prompts, **kw)
+
+    def stream(self, prompt, **kw):
+        """Submit one prompt and iterate its generated tokens as the
+        engine produces them."""
+        return iter(self._engine.add_request(prompt, **kw))
+
+    def close(self):
+        """Drain the engine: finish outstanding requests, refuse new."""
+        return self._engine.drain()
+
+
+def create_generation_predictor(model, **kw):
+    """Build a GenerationPredictor (engine-backed generation service)."""
+    return GenerationPredictor(model, **kw)

@@ -126,6 +126,30 @@ def _dropout(x, key, p):
                      x / (1 - p), 0.0).astype(x.dtype)
 
 
+def _lm_logits(c, wte, lnf_w, lnf_b, head, h_last):
+    """Final norm + LM head over the last hidden states (shared by
+    ``generate`` and the serving prefill/decode entry points)."""
+    h_last = _norm(h_last, lnf_w, lnf_b, c.layer_norm_epsilon)
+    w = wte.T if c.tie_word_embeddings else head
+    return jnp.matmul(h_last, w,
+                      precision=matmul_precision()).astype(jnp.float32)
+
+
+def _rope_rows(x, pos, base=10000.0):
+    """apply_rope for single-token rows ``x[B, 1, nh, hd]`` sitting at
+    PER-ROW positions ``pos[B]`` (the serving decode twin of
+    ``apply_rope(x, offset=pos)``, whose offset is one scalar)."""
+    b, s, h, d = x.shape
+    inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = pos.astype(jnp.float32)[:, None] * inv[None, :]  # [B, d/2]
+    sin = jnp.sin(freqs)[:, None, None, :]
+    cos = jnp.cos(freqs)[:, None, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return out.astype(x.dtype)
+
+
 class GPTForCausalLM(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
@@ -482,8 +506,123 @@ class GPTForCausalLM(Layer):
                                                  axis=0)
         return h
 
+    # -- serving entry points (paddle_tpu.serving.LLMEngine) -----------------
+    def decode_state(self):
+        """Raw device weights for the serving prefill/decode programs (one
+        dict the engine passes through jit unchanged — the arrays stay
+        device-resident, never re-hydrated per step)."""
+        c = self.config
+        return {
+            "lws": {n: getattr(self, n)._data for n in self._stacked()},
+            "wte": self.wte._data,
+            "wpe": None if c.use_rope else self.wpe._data,
+            "lnf_w": self.lnf_w._data,
+            "lnf_b": self.lnf_b._data,
+            "head": (None if c.tie_word_embeddings else self.lm_head._data),
+        }
+
+    def prefill_slot(self, w, ids, length):
+        """Pure prefill over ONE right-padded prompt ``ids[1, Sb]`` of true
+        length ``length`` (traced scalar): returns K/V chunks
+        ``[L, 1, Sb, nh, hd]`` zeroed beyond ``length`` plus the fp32
+        next-token logits ``[1, V]`` read at position ``length - 1``.
+
+        ``Sb`` is a power-of-two bucket, so the engine compiles
+        O(log S_max) prefill programs however many prompt lengths arrive.
+        Built on the same ``_cached_layers`` scan as ``generate`` — the
+        engine's first token is token-identical to ``generate``'s."""
+        c = self.config
+        nh, H = c.num_heads, c.hidden_size
+        hd = H // nh
+        B, Sb = ids.shape
+        dt = jnp.dtype(c.dtype)
+        ck0 = jnp.zeros((c.num_layers, B, Sb, nh, hd), dt)
+        cv0 = jnp.zeros((c.num_layers, B, Sb, nh, hd), dt)
+        h = self._embed(c, w["wte"], w["wpe"], ids, 0)
+        h, ck, cv = self._cached_layers(c, w["lws"], h, ck0, cv0, 0)
+        # zero the padded tail so arena rows only ever hold live K/V
+        valid = (jnp.arange(Sb) < length)[None, None, :, None, None]
+        ck = jnp.where(valid, ck, jnp.zeros((), ck.dtype))
+        cv = jnp.where(valid, cv, jnp.zeros((), cv.dtype))
+        h_last = jax.lax.dynamic_slice_in_dim(h, length - 1, 1, axis=1)
+        logits = _lm_logits(c, w["wte"], w["lnf_w"], w["lnf_b"], w["head"],
+                            h_last[:, 0])
+        return ck, cv, logits
+
+    def decode_slots(self, w, tok, pos, cache_k, cache_v):
+        """One decode step for B independent slot rows at PER-ROW positions
+        (the serving twin of ``_cached_layers``, whose position is one
+        scalar for the whole batch).
+
+        tok ``[B]`` int32, pos ``[B]`` int32, cache_k/v ``[L, B, S, nh,
+        hd]`` (the engine's KV arena).  Writes each row's K/V at
+        ``pos[row]`` (one-hot select — dynamic_update_slice needs a scalar
+        start), attends to ``kpos <= pos[row]``, and returns
+        ``(logits [B, V] fp32, new cache_k, new cache_v)``.  Rows are
+        independent, so a slot's trajectory is token-identical to a
+        ``generate`` call decoding the same request alone."""
+        c = self.config
+        nh = c.num_heads
+        eps = c.layer_norm_epsilon
+        H = c.hidden_size
+        hd = H // nh
+        B = tok.shape[0]
+        S = cache_k.shape[2]
+        scale = 1.0 / math.sqrt(hd)
+        h = jnp.take(w["wte"], tok, axis=0)[:, None, :]
+        if w["wpe"] is not None:
+            h = h + jnp.take(w["wpe"], pos, axis=0)[:, None, :]
+        kpos = jnp.arange(S)
+        mask = kpos[None, :] <= pos[:, None]                     # [B, S]
+        write = kpos[None, :, None, None] == pos[:, None, None, None]
+
+        def body(hh, xs):
+            lw, ck, cv = xs
+            x = _norm(hh, lw["ln1_w"], lw["ln1_b"], eps)
+            qkv = jnp.matmul(x, lw["qkv_w"], precision=matmul_precision()) \
+                + lw["qkv_b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, 1, nh, hd)
+            k = k.reshape(B, 1, nh, hd)
+            v = v.reshape(B, 1, nh, hd)
+            if c.use_rope:
+                q = _rope_rows(q, pos)
+                k = _rope_rows(k, pos)
+            ck = jnp.where(write, k.astype(ck.dtype), ck)
+            cv = jnp.where(write, v.astype(cv.dtype), cv)
+            logits = jnp.einsum("bqhd,bkhd->bhqk",
+                                (q * scale).astype(jnp.float32),
+                                ck.astype(jnp.float32))
+            logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+            p = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cv.dtype), cv)
+            o = o.reshape(B, 1, H)
+            a = jnp.matmul(o, lw["proj_w"], precision=matmul_precision()) \
+                + lw["proj_b"]
+            hh = hh + a
+            x = _norm(hh, lw["ln2_w"], lw["ln2_b"], eps)
+            if c.num_experts > 0:
+                from ..incubate.moe import moe_ffn
+                f, _aux = moe_ffn(
+                    x, lw["gate_w"], lw["fc1_w"], lw["fc1_b"],
+                    lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
+                    capacity_factor=c.moe_capacity_factor)
+            else:
+                up = jnp.matmul(x, lw["fc1_w"],
+                                precision=matmul_precision()) + lw["fc1_b"]
+                f = jnp.matmul(jax.nn.gelu(up), lw["fc2_w"],
+                               precision=matmul_precision()) + lw["fc2_b"]
+            return hh + f, (ck, cv)
+
+        h, (cache_k, cache_v) = jax.lax.scan(
+            body, h, (w["lws"], cache_k, cache_v))
+        logits = _lm_logits(c, w["wte"], w["lnf_w"], w["lnf_b"], w["head"],
+                            h[:, 0])
+        return logits, cache_k, cache_v
+
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
-                 temperature=1.0, top_k=0, eos_token_id=None, seed=None):
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 seed=None):
         """Autoregressive decoding with a static KV cache, fully compiled
         (prefill + lax.scan decode loop in ONE XLA program).
 
@@ -491,7 +630,9 @@ class GPTForCausalLM(Layer):
         (masked_multihead_attention_kernel.cu + paddlenlp generate);
         TPU-native: static cache shapes, dynamic_update_slice writes,
         whole loop under jit.  Returns [B, T + max_new_tokens] token ids
-        (after eos, the row keeps emitting eos)."""
+        (after eos, the row keeps emitting eos).  Sampling shares
+        ``serving.sampling`` with the continuous-batching engine, so
+        ``serving.LLMEngine`` reproduces this method token for token."""
         c = self.config
         names = self._stacked()
         lws = {n: getattr(self, n)._data for n in names}
@@ -511,21 +652,16 @@ class GPTForCausalLM(Layer):
                else _DEFAULT_GEN.next_key())
         eos = -1 if eos_token_id is None else int(eos_token_id)
 
+        from ..serving.sampling import sample_tokens
+
         def logits_of(h_last):
-            h_last = _norm(h_last, lnf_w, lnf_b, c.layer_norm_epsilon)
-            w = wte.T if c.tie_word_embeddings else head
-            return jnp.matmul(h_last, w,
-                              precision=matmul_precision()).astype(
-                                  jnp.float32)
+            return _lm_logits(c, wte, lnf_w, lnf_b, head, h_last)
 
         def sample(lg, k):
-            if not do_sample:
-                return jnp.argmax(lg, axis=-1).astype(ids.dtype)
-            lg = lg / jnp.maximum(temperature, 1e-6)
-            if top_k and top_k > 0:
-                kth = jnp.sort(lg, axis=-1)[..., -int(top_k)][..., None]
-                lg = jnp.where(lg < kth, -1e30, lg)
-            return jax.random.categorical(k, lg, axis=-1).astype(ids.dtype)
+            return sample_tokens(lg, k, do_sample=bool(do_sample),
+                                 temperature=float(temperature),
+                                 top_k=int(top_k), top_p=float(top_p),
+                                 out_dtype=ids.dtype)
 
         def run(lws, wte, wpe, lnf_w, lnf_b, head, ids, key):
             nh, H = c.num_heads, c.hidden_size
@@ -559,14 +695,22 @@ class GPTForCausalLM(Layer):
 
         # sampling params only affect the trace when do_sample is on
         cache_key = (B, T, int(max_new_tokens), eos,
-                     (bool(do_sample), float(temperature), int(top_k))
+                     (bool(do_sample), float(temperature), int(top_k),
+                      float(top_p))
                      if do_sample else False)
+        # LRU-bounded executable cache: long-running processes seeing many
+        # request shapes must not leak compiled programs (the serving
+        # engine avoids the per-shape explosion entirely by bucketing)
+        from collections import OrderedDict
         jits = getattr(self, "_gen_cache", None)
         if jits is None:
-            jits = self._gen_cache = {}
-        if cache_key not in jits:
-            if len(jits) >= 16:  # bound retained executables (FIFO evict)
-                jits.pop(next(iter(jits)))
+            jits = self._gen_cache = OrderedDict()
+        cap = max(1, int(getattr(self, "_gen_cache_max", 16)))
+        if cache_key in jits:
+            jits.move_to_end(cache_key)
+        else:
+            while len(jits) >= cap:
+                jits.popitem(last=False)  # evict least-recently-used
             jits[cache_key] = jax.jit(run)
         out = jits[cache_key](lws, wte, wpe, lnf_w, lnf_b, head, ids, key)
         return Tensor._wrap(out)

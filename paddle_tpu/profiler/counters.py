@@ -22,6 +22,11 @@ Well-known names (see README "Observability" for the full table):
   io.reader_ns / io.prefetch_stall_ns / io.queue_wait_ns
   dist.collectives / dist.<op> / dist.mp_collectives
   optimizer.steps
+  serving.requests / serving.prefill_batches / serving.decode_steps
+  serving.decode_tokens / serving.evictions / serving.evictions.<reason>
+  serving.retraces (serving program compiles; 0 in steady state)
+  serving.queue_wait_ns
+  serving.slot_occupancy / serving.prefill_programs (gauges)
 """
 
 from __future__ import annotations

@@ -1,0 +1,15 @@
+"""paddle_tpu.serving — continuous-batching LLM inference.
+
+A slot-based serving engine (Orca-style iteration-level scheduling over a
+device-resident KV arena, vLLM-style admission specialised to TPU static
+shapes) plus the sampling helpers it shares with ``GPT.generate``.  See
+``serving.engine`` for the design notes and README "Serving" for the API
+tour.
+"""
+
+from .engine import (EngineBackpressure, EngineClosed, LLMEngine,  # noqa: F401
+                     Request, bucket_length)
+from .sampling import filter_logits, sample_tokens  # noqa: F401
+
+__all__ = ["LLMEngine", "Request", "EngineBackpressure", "EngineClosed",
+           "bucket_length", "filter_logits", "sample_tokens"]

@@ -1,0 +1,69 @@
+"""Shared decode-time sampling (GPT.generate + serving.LLMEngine).
+
+ONE implementation of the temperature / top-k / top-p logits transform and
+the token draw, traced by BOTH ``GPTForCausalLM.generate`` (python-scalar
+knobs, one PRNG key per step over [B, V] logits) and the serving engine's
+decode program (per-slot knob ARRAYS, one key per slot) — the two paths
+can never drift numerically, which is what makes engine outputs
+token-identical to per-request ``generate``.
+
+Knob semantics at neutral values are the IDENTITY transform: python
+scalars (``top_k=0``, ``top_p=1.0``) skip the work statically, while
+traced per-slot values apply it but reduce to a no-op (the top-k
+threshold degenerates to the row minimum, the nucleus keeps every
+token), so a slot decoding with neutral knobs inside the engine's shared
+program produces bitwise the same logits as a ``generate`` trace that
+never emitted the transform at all.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_traced(x):
+    return isinstance(x, (jax.Array, jax.core.Tracer))
+
+
+def filter_logits(lg, temperature=1.0, top_k=0, top_p=1.0):
+    """Temperature scaling, then top-k, then top-p (nucleus) masking over
+    fp32 logits ``lg[..., V]``.  Masked entries become -1e30 (exp == 0
+    exactly under softmax).  Knobs may be python scalars or traced values
+    broadcastable against ``lg[..., 0]``."""
+    V = lg.shape[-1]
+    lg = lg / jnp.maximum(temperature, 1e-6)
+    if _is_traced(top_k):
+        srt = jnp.sort(lg, axis=-1)  # ascending
+        k = jnp.clip(top_k, 0, V)
+        # k <= 0 disables: threshold at the row min masks nothing
+        idx = jnp.where(k <= 0, 0, V - jnp.maximum(k, 1)).astype(jnp.int32)
+        idx = jnp.broadcast_to(idx, lg.shape[:-1])[..., None]
+        kth = jnp.take_along_axis(srt, idx, axis=-1)
+        lg = jnp.where(lg < kth, -1e30, lg)
+    elif top_k and int(top_k) > 0:
+        kth = jnp.sort(lg, axis=-1)[..., -min(int(top_k), V)][..., None]
+        lg = jnp.where(lg < kth, -1e30, lg)
+    if _is_traced(top_p) or float(top_p) < 1.0:
+        s = -jnp.sort(-lg, axis=-1)  # descending
+        probs = jax.nn.softmax(s, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep a token while the mass strictly BEFORE it is < p; the top
+        # token is always kept (0 < p)
+        keep = (cum - probs) < top_p
+        cnt = jnp.maximum(jnp.sum(keep, axis=-1), 1)
+        cutoff = jnp.take_along_axis(
+            s, (cnt - 1)[..., None].astype(jnp.int32), axis=-1)
+        lg = jnp.where(lg < cutoff, -1e30, lg)
+    return lg
+
+
+def sample_tokens(lg, key, *, do_sample=True, temperature=1.0, top_k=0,
+                  top_p=1.0, out_dtype=jnp.int32):
+    """Next tokens from fp32 logits ``lg[..., V]``.  Static
+    ``do_sample=False`` is pure argmax (no PRNG traced); otherwise a
+    categorical draw over the filtered distribution."""
+    if do_sample is False:
+        return jnp.argmax(lg, axis=-1).astype(out_dtype)
+    flg = filter_logits(lg, temperature, top_k, top_p)
+    return jax.random.categorical(key, flg, axis=-1).astype(out_dtype)

@@ -17,6 +17,13 @@ fallback, window 2 = scan compile), every measured K-step window must be
 exactly ONE XLA dispatch — ``jit.host.dispatches == jit.steps / K`` —
 again with zero retraces / rehydrates / host binds.
 
+A third phase gates the serving engine (``paddle_tpu.serving.LLMEngine``):
+warmup requests compile one prefill/insert program per power-of-two
+bucket plus the single decode program; measured requests that reuse those
+buckets must show ``serving.retraces == 0`` and zero jit.* trace/hydrate/
+host-bind movement — continuous batching reaches the same
+zero-python-overhead steady state as training.
+
 Prints one JSON line; raises AssertionError on any violation.  Wired as a
 tier-1 test via tests/test_profiler.py.  Run directly:
 ``python scripts/check_counters.py``.
@@ -29,6 +36,8 @@ WARMUP = 2
 MEASURE = 2
 FUSED_K = 2
 FUSED_MEASURE = 2  # measured windows = FUSED_MEASURE * FUSED_K steps
+SERVE_LENS_WARM = (3, 6)      # buckets {4, 8} with min_bucket=4
+SERVE_LENS_MEASURE = (4, 5)   # same buckets — must retrace NOTHING
 
 
 def run():
@@ -109,14 +118,54 @@ def run():
                        for k, want in finvariants.items()
                        if fsteady.get(k, 0) != want})
 
+    # ---- serving steady-state gate: warm buckets never retrace ----------
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import LLMEngine
+
+    paddle.seed(0)
+    scfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=4, max_seq_len=32,
+                     use_flash_attention=False)
+    smodel = GPTForCausalLM(scfg)
+    smodel.eval()
+    eng = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4)
+    rng = np.random.RandomState(7)
+
+    def serve(lens):
+        hs = [eng.add_request(rng.randint(0, 64, size=n).tolist(),
+                              max_new_tokens=3) for n in lens]
+        while not all(h.is_finished for h in hs):
+            eng.step()
+
+    serve(SERVE_LENS_WARM)  # compiles prefill/insert per bucket + decode
+    sbefore = counters.snapshot()
+    serve(SERVE_LENS_MEASURE)
+    ssteady = counters.delta(sbefore)
+
+    sinvariants = {
+        "serving.retraces": 0,
+        "jit.traces": 0,
+        "jit.hydrates": 0,
+        "jit.syncs": 0,
+        "serving.requests": len(SERVE_LENS_MEASURE),
+        "serving.evictions": len(SERVE_LENS_MEASURE),
+    }
+    sinvariants.update({"jit.host." + k: 0 for k in pjit._HOST_SYNC_KEYS})
+    violations.update({f"serving:{k}": (ssteady.get(k, 0), want)
+                       for k, want in sinvariants.items()
+                       if ssteady.get(k, 0) != want})
+
     result = {"metric": "steady_state_counter_violations",
               "value": len(violations),
               "unit": f"violations/{MEASURE} steps "
-                      f"+ {FUSED_MEASURE} fused windows",
+                      f"+ {FUSED_MEASURE} fused windows "
+                      f"+ {len(SERVE_LENS_MEASURE)} served requests",
               "violations": {k: {"got": got, "want": want}
                              for k, (got, want) in violations.items()},
               "steady_delta": steady,
-              "fused_steady_delta": fsteady}
+              "fused_steady_delta": fsteady,
+              "serving_steady_delta": ssteady,
+              "serving_prefill_programs": eng.stats()["prefill_programs"]}
     print(json.dumps(result))
     if violations:
         raise AssertionError(

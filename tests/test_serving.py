@@ -1,0 +1,368 @@
+"""Continuous-batching serving engine (paddle_tpu.serving).
+
+The load-bearing contract: LLMEngine output is TOKEN-IDENTICAL to running
+each request alone through GPT.generate with the same seed — continuous
+batching, slot placement, bucketed prefill, and staggered arrival must be
+invisible in the tokens.  Plus the robustness surface: eviction/slot
+reuse, EOS/deadline/cancel, backpressure, drain, and the O(log S_max)
+prefill-program bound."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import counters
+
+
+def _model(**kw):
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=32,
+                    use_flash_attention=False, **kw)
+    paddle.seed(31)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _engine(m, **kw):
+    from paddle_tpu.serving import LLMEngine
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("min_bucket", 4)
+    return LLMEngine(m, **kw)
+
+
+def _ref_generate(m, prompt, max_new, **kw):
+    """Sequential reference: the request alone through GPT.generate."""
+    out = np.asarray(m.generate(paddle.to_tensor(np.asarray([prompt])),
+                                max_new_tokens=max_new, **kw).numpy())[0]
+    return out[len(prompt):]
+
+
+def _run(eng, handles, limit=200):
+    n = 0
+    while not all(h.is_finished for h in handles):
+        eng.step()
+        n += 1
+        assert n < limit, "engine did not converge"
+    return n
+
+
+class TestEngineMatchesGenerate:
+    @pytest.mark.parametrize("use_rope", [False, True])
+    def test_greedy_token_identical(self, use_rope):
+        m = _model(use_rope=use_rope)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 64, size=n).tolist()
+                   for n in (5, 3, 9, 6, 11)]
+        refs = [_ref_generate(m, p, 6) for p in prompts]
+        eng = _engine(m)
+        hs = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+        _run(eng, hs)
+        for h, r in zip(hs, refs):
+            assert np.array_equal(h.tokens, r), (h.tokens, list(r))
+            assert h.finish_reason == "length"
+
+    def test_sampling_token_identical(self):
+        """Per-slot temperature/top-k/top-p + per-request key chain
+        reproduce generate's draws exactly (shared serving.sampling)."""
+        m = _model()
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, 64, size=n).tolist() for n in (4, 7, 11)]
+        kw = dict(do_sample=True, temperature=0.8, top_k=8, top_p=0.9)
+        refs = [_ref_generate(m, p, 5, seed=100 + i, **kw)
+                for i, p in enumerate(prompts)]
+        eng = _engine(m, max_slots=4)
+        hs = [eng.add_request(p, max_new_tokens=5, seed=100 + i, **kw)
+              for i, p in enumerate(prompts)]
+        _run(eng, hs)
+        for h, r in zip(hs, refs):
+            assert np.array_equal(h.tokens, r), (h.tokens, list(r))
+
+    def test_staggered_arrivals_identical(self):
+        """Requests joining mid-flight decode next to half-finished ones
+        and still match their solo trajectories."""
+        m = _model()
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, 64, size=n).tolist()
+                   for n in (6, 4, 8, 5)]
+        refs = [_ref_generate(m, p, 6) for p in prompts]
+        eng = _engine(m, max_slots=4)
+        hs = [eng.add_request(prompts[0], max_new_tokens=6)]
+        eng.step()
+        eng.step()
+        hs.append(eng.add_request(prompts[1], max_new_tokens=6))
+        eng.step()
+        hs += [eng.add_request(p, max_new_tokens=6) for p in prompts[2:]]
+        _run(eng, hs)
+        for h, r in zip(hs, refs):
+            assert np.array_equal(h.tokens, r), (h.tokens, list(r))
+
+    def test_mixed_greedy_and_sampled_slots(self):
+        m = _model()
+        rng = np.random.default_rng(3)
+        pg = rng.integers(0, 64, size=5).tolist()
+        ps = rng.integers(0, 64, size=7).tolist()
+        ref_g = _ref_generate(m, pg, 5)
+        ref_s = _ref_generate(m, ps, 5, do_sample=True, temperature=0.7,
+                              top_k=6, seed=9)
+        eng = _engine(m)
+        hg = eng.add_request(pg, max_new_tokens=5)
+        hsmp = eng.add_request(ps, max_new_tokens=5, do_sample=True,
+                               temperature=0.7, top_k=6, seed=9)
+        _run(eng, [hg, hsmp])
+        assert np.array_equal(hg.tokens, ref_g)
+        assert np.array_equal(hsmp.tokens, ref_s)
+
+
+class TestSlots:
+    def test_eviction_and_reuse(self):
+        """5 requests through 2 slots: slots are freed on finish and
+        rehanded; everyone completes with the solo trajectory."""
+        m = _model()
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(0, 64, size=n).tolist()
+                   for n in (5, 3, 7, 4, 6)]
+        refs = [_ref_generate(m, p, 4) for p in prompts]
+        before = counters.snapshot()
+        eng = _engine(m, max_slots=2, queue_size=8)
+        hs = [eng.add_request(p, max_new_tokens=4) for p in prompts]
+        _run(eng, hs)
+        delta = counters.delta(before)
+        assert delta.get("serving.evictions", 0) == 5
+        assert delta.get("serving.evictions.length", 0) == 5
+        for h, r in zip(hs, refs):
+            assert np.array_equal(h.tokens, r)
+        # all slots free again, occupancy gauge settled at 0
+        assert eng.stats()["free_slots"] == 2
+        assert counters.get("serving.slot_occupancy") == 0.0
+
+    def test_eos_evicts_early(self):
+        m = _model()
+        rng = np.random.default_rng(5)
+        p = rng.integers(0, 64, size=4).tolist()
+        # eos = the 2nd greedily generated token → finishes at its first
+        # occurrence (which is index 0 if greedy repeats the token)
+        ref = _ref_generate(m, p, 8)
+        eos = int(ref[1])
+        stop = int(np.flatnonzero(ref == eos)[0])
+        eng = _engine(m)
+        h = eng.add_request(p, max_new_tokens=8, eos_token_id=eos)
+        _run(eng, [h])
+        assert h.finish_reason == "eos"
+        assert h.tokens == list(map(int, ref[: stop + 1]))
+        assert eng.stats()["free_slots"] == eng.max_slots
+
+    def test_deadline_expires_in_queue(self):
+        """deadline_s=0 is already past at admission: the request is
+        dropped from the queue without ever taking a slot."""
+        m = _model()
+        rng = np.random.default_rng(6)
+        p = rng.integers(0, 64, size=4).tolist()
+        eng = _engine(m)
+        h = eng.add_request(p, max_new_tokens=20, deadline_s=0.0)
+        _run(eng, [h])
+        assert h.finish_reason == "deadline"
+        assert h.tokens == []
+        assert eng.stats()["free_slots"] == eng.max_slots
+
+    def test_deadline_evicts_running_with_partial_output(self):
+        m = _model()
+        rng = np.random.default_rng(6)
+        p = rng.integers(0, 64, size=4).tolist()
+        eng = _engine(m)
+        h = eng.add_request(p, max_new_tokens=20, deadline_s=60.0)
+        eng.step()  # admitted; prefill emits the first token
+        first = len(h.tokens)
+        assert first >= 1 and h.state == "running"
+        h.deadline = 0.0  # force expiry; next sweep evicts
+        _run(eng, [h])
+        assert h.finish_reason == "deadline"
+        assert len(h.tokens) == first  # sweep runs before decode
+        assert eng.stats()["free_slots"] == eng.max_slots
+
+    def test_cancel_active_and_queued(self):
+        m = _model()
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, 64, size=4).tolist() for _ in range(3)]
+        eng = _engine(m, max_slots=1, queue_size=8)
+        h0 = eng.add_request(prompts[0], max_new_tokens=20)
+        h1 = eng.add_request(prompts[1], max_new_tokens=4)
+        eng.step()
+        assert h0.state == "running" and h1.state == "queued"
+        h0.cancel()   # active
+        h1.cancel()   # still queued
+        h2 = eng.add_request(prompts[2], max_new_tokens=3)
+        _run(eng, [h0, h1, h2])
+        assert h0.finish_reason == "cancelled" and len(h0.tokens) >= 1
+        assert h1.finish_reason == "cancelled" and h1.tokens == []
+        assert h2.finish_reason == "length" and len(h2.tokens) == 3
+
+
+class TestRobustness:
+    def test_backpressure_nonblocking_raises(self):
+        from paddle_tpu.serving import EngineBackpressure
+        m = _model()
+        eng = _engine(m, max_slots=1, queue_size=2)
+        eng.add_request([1, 2, 3], max_new_tokens=4)
+        eng.add_request([1, 2, 3], max_new_tokens=4)
+        with pytest.raises(EngineBackpressure):
+            eng.add_request([1, 2, 3], max_new_tokens=4, block=False)
+
+    def test_backpressure_blocking_times_out(self):
+        from paddle_tpu.serving import EngineBackpressure
+        m = _model()
+        eng = _engine(m, max_slots=1, queue_size=1)
+        eng.add_request([1, 2, 3], max_new_tokens=4)
+        with pytest.raises(EngineBackpressure, match="timed out"):
+            eng.add_request([1, 2, 3], max_new_tokens=4, block=True,
+                            timeout=0.05)
+
+    def test_backpressure_releases_as_queue_drains(self):
+        from paddle_tpu.serving import EngineBackpressure
+        m = _model()
+        eng = _engine(m, max_slots=1, queue_size=1)
+        h0 = eng.add_request([1, 2, 3], max_new_tokens=2)  # fills queue
+        with pytest.raises(EngineBackpressure):
+            eng.add_request([2, 3, 4], max_new_tokens=2, block=False)
+        eng.step()  # h0 admitted to the slot → queue has room again
+        h1 = eng.add_request([2, 3, 4], max_new_tokens=2, block=False)
+        _run(eng, [h0, h1])
+        assert all(h.finish_reason == "length" for h in (h0, h1))
+
+    def test_drain_finishes_everything_and_closes(self):
+        from paddle_tpu.serving import EngineClosed
+        m = _model()
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(0, 64, size=4).tolist() for _ in range(4)]
+        eng = _engine(m, max_slots=2, queue_size=8)
+        hs = [eng.add_request(p, max_new_tokens=3) for p in prompts]
+        eng.step()
+        done = eng.drain()
+        assert all(h.is_finished for h in hs)
+        assert {r.rid for r in done} | {h.rid for h in hs} \
+            == {h.rid for h in hs}
+        assert not eng.has_work()
+        with pytest.raises(EngineClosed):
+            eng.add_request([1, 2], max_new_tokens=2)
+
+    def test_request_validation(self):
+        m = _model()
+        eng = _engine(m)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            eng.add_request(list(range(20)), max_new_tokens=20)
+        with pytest.raises(ValueError, match="empty"):
+            eng.add_request([], max_new_tokens=2)
+
+    def test_streaming_iterator(self):
+        m = _model()
+        rng = np.random.default_rng(9)
+        p = rng.integers(0, 64, size=5).tolist()
+        ref = _ref_generate(m, p, 6)
+        eng = _engine(m)
+        h = eng.add_request(p, max_new_tokens=6)
+        streamed = list(h)  # pumps eng.step() internally
+        assert np.array_equal(streamed, ref)
+        assert np.array_equal(h.output_ids(), list(p) + list(ref))
+
+
+class TestBuckets:
+    def test_bucket_length(self):
+        from paddle_tpu.serving import bucket_length
+        assert bucket_length(1, min_bucket=4) == 4
+        assert bucket_length(4, min_bucket=4) == 4
+        assert bucket_length(5, min_bucket=4) == 8
+        assert bucket_length(9, min_bucket=4) == 16
+        assert bucket_length(9, min_bucket=4, max_len=12) == 12
+
+    def test_prefill_programs_bounded_and_no_steady_retraces(self):
+        """Many distinct prompt lengths → O(log S_max) prefill programs;
+        once buckets are warm, new requests trace NOTHING."""
+        m = _model()
+        rng = np.random.default_rng(10)
+        eng = _engine(m, max_slots=2, queue_size=32)
+        lens = [3, 4, 5, 6, 7, 9, 11, 13, 15]  # buckets {4, 8, 16}
+        hs = [eng.add_request(rng.integers(0, 64, size=n).tolist(),
+                              max_new_tokens=2) for n in lens]
+        _run(eng, hs)
+        assert eng.stats()["prefill_programs"] == 3
+        assert counters.get("serving.prefill_programs") == 3
+        # steady state: same buckets again — zero serving retraces
+        before = counters.snapshot()
+        hs = [eng.add_request(rng.integers(0, 64, size=n).tolist(),
+                              max_new_tokens=2) for n in (3, 6, 12)]
+        _run(eng, hs)
+        delta = counters.delta(before)
+        assert delta.get("serving.retraces", 0) == 0, delta
+        assert delta.get("jit.traces", 0) == 0
+        assert eng.stats()["prefill_programs"] == 3
+
+
+class TestGenerateExtensions:
+    def test_engine_generate_blocking_api(self):
+        m = _model()
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, 64, size=n).tolist() for n in (4, 6, 3)]
+        refs = [_ref_generate(m, p, 4) for p in prompts]
+        eng = _engine(m, max_slots=2, queue_size=2)  # oversubscribed
+        outs = eng.generate(prompts, max_new_tokens=4)
+        for o, p, r in zip(outs, prompts, refs):
+            assert np.array_equal(o, list(p) + list(r))
+
+    def test_generation_predictor_routes_through_engine(self):
+        from paddle_tpu.inference import GenerationPredictor
+        m = _model()
+        rng = np.random.default_rng(12)
+        prompts = [rng.integers(0, 64, size=n).tolist() for n in (5, 7)]
+        refs = [_ref_generate(m, p, 4) for p in prompts]
+        pred = GenerationPredictor(m, max_slots=2, max_seq_len=32,
+                                   min_bucket=4)
+        outs = pred.generate(prompts, max_new_tokens=4)
+        for o, p, r in zip(outs, prompts, refs):
+            assert np.array_equal(o, list(p) + list(r))
+        streamed = list(pred.stream(prompts[0], max_new_tokens=4))
+        assert np.array_equal(streamed, refs[0])
+        pred.close()
+        from paddle_tpu.serving import EngineClosed
+        with pytest.raises(EngineClosed):
+            pred.engine.add_request([1], max_new_tokens=1)
+
+    def test_generate_top_p_reproducible_and_constraining(self):
+        """top_p in GPT.generate: seeded reproducibility; p→0 degenerates
+        to greedy (nucleus keeps only the top token)."""
+        m = _model()
+        ids = paddle.randint(0, 64, [2, 4])
+        a = np.asarray(m.generate(ids, max_new_tokens=5, do_sample=True,
+                                  top_p=0.7, seed=3).numpy())
+        b = np.asarray(m.generate(ids, max_new_tokens=5, do_sample=True,
+                                  top_p=0.7, seed=3).numpy())
+        assert np.array_equal(a, b)
+        greedy = np.asarray(m.generate(ids, max_new_tokens=5).numpy())
+        tiny = np.asarray(m.generate(ids, max_new_tokens=5, do_sample=True,
+                                     top_p=1e-6, seed=5).numpy())
+        assert np.array_equal(tiny, greedy)
+
+    def test_gen_cache_lru_bound(self):
+        """_gen_cache is LRU-bounded: recently used shapes survive, the
+        stalest executable is evicted."""
+        m = _model()
+        m._gen_cache_max = 2
+        ids3 = paddle.randint(0, 64, [1, 3])
+        ids4 = paddle.randint(0, 64, [1, 4])
+        ids5 = paddle.randint(0, 64, [1, 5])
+        m.generate(ids3, max_new_tokens=2)   # A
+        m.generate(ids4, max_new_tokens=2)   # B
+        assert len(m._gen_cache) == 2
+        m.generate(ids3, max_new_tokens=2)   # hit A → B is now LRU
+        m.generate(ids5, max_new_tokens=2)   # C evicts B
+        keys = list(m._gen_cache)
+        assert len(keys) == 2
+        assert {k[1] for k in keys} == {3, 5}
+
+    def test_moe_model_serves(self):
+        m = _model(num_experts=2)
+        eng = _engine(m)
+        h = eng.add_request([1, 2, 3, 4], max_new_tokens=3)
+        _run(eng, [h])
+        assert len(h.tokens) == 3
