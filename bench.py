@@ -94,6 +94,87 @@ def _run_leg(cfg, batch, seq, iters, rounds, fused_steps=1):
     return tokens_per_sec, spread, n_params, phases
 
 
+def _run_ckpt_leg(cfg, batch, seq, iters, fused_steps=1,
+                  save_every_windows=2, seed=0):
+    """Checkpointed-training overhead: the same steady dispatch loop run
+    twice — bare, then with async ``resilience.CheckpointManager`` saves
+    every ``save_every_windows`` windows (disk writes overlap the next
+    window).  Reports the throughput overhead fraction and asserts the
+    one-counter-gated-sync-per-save budget."""
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.io import Window
+    from paddle_tpu.jit import CompiledTrainStep
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
+    from paddle_tpu.profiler import counters
+    from paddle_tpu.resilience import CheckpointManager
+
+    paddle.seed(seed)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    ids = paddle.randint(0, cfg.vocab_size, [batch, seq])
+    labels = paddle.randint(0, cfg.vocab_size, [batch, seq])
+
+    def loss_fn(m, x, l):
+        return crit(m(x), l)
+
+    k = max(1, int(fused_steps))
+    step = CompiledTrainStep(model, loss_fn, opt, fused_steps=k)
+    if k > 1:
+        win = Window(
+            (paddle.to_tensor(np.stack([np.asarray(ids.numpy())] * k)),
+             paddle.to_tensor(np.stack([np.asarray(labels.numpy())] * k))),
+            k)
+        dispatch = lambda: step(win)
+    else:
+        dispatch = lambda: step(ids, labels)
+    dispatch()
+    dispatch().numpy()  # warm: all traces + compiles done
+
+    n_windows = max(save_every_windows, iters // k)
+    t0 = time.perf_counter()
+    for _ in range(n_windows):
+        loss = dispatch()
+    loss.numpy()
+    base_s = time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as ckdir:
+        mgr = CheckpointManager(ckdir, keep_last=2, async_save=True)
+        before = counters.snapshot()
+        t0 = time.perf_counter()
+        gs = 0
+        for i in range(n_windows):
+            loss = dispatch()
+            gs += k
+            if (i + 1) % save_every_windows == 0:
+                mgr.save(step, gs, blocking=False)
+        loss.numpy()
+        mgr.wait()
+        ckpt_s = time.perf_counter() - t0
+        delta = counters.delta(before)
+
+    saves = delta.get("resilience.saves", 0)
+    tokens = batch * seq * k * n_windows
+    leg = {"fused_steps": k,
+           "windows": n_windows,
+           "async_saves": saves,
+           "tokens_per_sec": round(tokens / max(ckpt_s, 1e-9), 2),
+           "bare_tokens_per_sec": round(tokens / max(base_s, 1e-9), 2),
+           "ckpt_overhead_frac": round(max(0.0, ckpt_s / max(base_s, 1e-9)
+                                           - 1.0), 4),
+           "save_ms_total": delta.get("resilience.save_ms", 0),
+           "syncs": delta.get("jit.syncs", 0),
+           "retraces": delta.get("jit.traces", 0),
+           "rehydrates": delta.get("jit.hydrates", 0)}
+    if leg["syncs"] != saves or leg["retraces"] or leg["rehydrates"]:
+        raise AssertionError(
+            f"checkpoint leg broke the one-sync-per-save budget: {leg}")
+    del step, model, opt
+    return leg
+
+
 def _run_serve_leg(cfg, n_requests=8, max_new=64, max_slots=8,
                    min_bucket=8, seed=0):
     """Continuous-batching serving vs sequential generate on the same
@@ -206,13 +287,17 @@ def main():
         # speedup number is informational on CPU
         out["serve"] = _run_serve_leg(cfg, n_requests=8, max_new=8,
                                       max_slots=4, min_bucket=4)
+        # tiny checkpoint leg: async-save overlap + one-sync-per-save
+        # budget (overhead number is informational on CPU)
+        out["ckpt"] = _run_ckpt_leg(cfg, 2, 128, 4,
+                                    fused_steps=max(1, fused_k))
         print(json.dumps(out))
         return
 
     which = os.environ.get("PTPU_BENCH", "all")
-    if which not in ("all", "760m", "125m", "serve"):
+    if which not in ("all", "760m", "125m", "serve", "ckpt"):
         raise SystemExit(
-            f"PTPU_BENCH={which!r}: expected all|760m|125m|serve")
+            f"PTPU_BENCH={which!r}: expected all|760m|125m|serve|ckpt")
     legs = {}
     if which in ("all", "760m"):
         cfg = GPTConfig.gpt3_760m(vocab_size=50304, max_seq_len=1024,
@@ -249,6 +334,16 @@ def main():
                 "fused_speedup": round(ftps / tps, 4),
                 "spread_frac": round(fspread, 4),
                 "phases": fphases}
+    if which in ("all", "ckpt"):
+        # checkpointed-training leg: steady fused windows with async saves
+        # overlapping the next window — reports ckpt_overhead_frac and
+        # gates the one-sync-per-save counter budget
+        ccfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
+                                   dtype="bfloat16",
+                                   use_flash_attention=True,
+                                   recompute="selective")
+        legs["gpt125m_ckpt"] = _run_ckpt_leg(ccfg, 16, 1024, 16,
+                                             fused_steps=max(1, fused_k))
     if which in ("all", "serve"):
         # serving leg: continuous batching vs sequential generate on 8
         # staggered mixed-length requests (acceptance: serve_speedup > 1
@@ -260,6 +355,16 @@ def main():
         legs["gpt125m_serve"] = _run_serve_leg(scfg, n_requests=8,
                                                max_new=64, max_slots=8)
 
+    if set(legs) == {"gpt125m_ckpt"}:  # ckpt-only run: overhead line
+        leg = legs["gpt125m_ckpt"]
+        print(json.dumps({
+            "metric": "gpt125m_ckpt_tokens_per_sec",
+            "value": leg["tokens_per_sec"],
+            "unit": "tokens/s",
+            "vs_baseline": leg["ckpt_overhead_frac"],  # vs bare loop
+            "legs": legs,
+        }))
+        return
     flag = ("gpt760m" if "gpt760m" in legs
             else "gpt125m" if "gpt125m" in legs else "gpt125m_serve")
     if flag == "gpt125m_serve":  # serve-only run: decode throughput line

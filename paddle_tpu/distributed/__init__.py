@@ -18,7 +18,8 @@ from .auto_parallel import (DistAttr, Partial, Placement, ProcessMesh,  # noqa: 
                             Replicate, Shard, dtensor_from_local,
                             dtensor_to_local, reshard, shard_layer,
                             shard_optimizer, shard_tensor, to_static)
-from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .checkpoint import (CheckpointCorrupt, load_state_dict,  # noqa: F401
+                         save_state_dict, wait_async_save)
 from .communication import (Group, P2POp, ReduceOp, all_gather,  # noqa: F401
                             all_gather_object, all_reduce, all_to_all,
                             barrier, batch_isend_irecv, broadcast,

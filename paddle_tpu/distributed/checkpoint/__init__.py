@@ -22,6 +22,7 @@ import atexit
 import json
 import os
 import threading
+import zlib
 
 import jax
 import numpy as np
@@ -30,6 +31,19 @@ from ...core.tensor import Tensor
 
 _ASYNC_THREADS = []
 _ASYNC_ERRORS = []
+_ASYNC_LOCK = threading.Lock()
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A saved chunk failed its checksum on load: the bytes on disk are not
+    the bytes that were written.  The message names the offending chunk so
+    operators can tell corruption from e.g. topology mismatch."""
+
+
+def _crc32(arr):
+    """Checksum of a chunk's raw bytes (dtype-stable: always computed on the
+    C-contiguous buffer of the array as saved)."""
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
 def _flatten(state_dict, prefix=""):
@@ -63,14 +77,30 @@ def _local_unique_chunks(arr):
 
 
 def wait_async_save():
-    """Block until pending async checkpoint writes finish; re-raise the
-    first write error so a failed save can't masquerade as success."""
-    while _ASYNC_THREADS:
-        _ASYNC_THREADS.pop().join()
-    if _ASYNC_ERRORS:
-        err = _ASYNC_ERRORS[0]
-        _ASYNC_ERRORS.clear()
-        raise RuntimeError("async checkpoint save failed") from err
+    """Block until pending async checkpoint writes finish and surface ALL
+    collected write errors, so a failed save can't masquerade as success.
+
+    Safe under concurrent callers: the thread list is snapshotted (never
+    destructively popped), every caller joins the same set, and bookkeeping
+    happens under a lock — two threads waiting at once both see every
+    failure instead of racing to steal threads/errors from each other."""
+    with _ASYNC_LOCK:
+        pending = list(_ASYNC_THREADS)
+    for t in pending:
+        t.join()
+    with _ASYNC_LOCK:
+        for t in pending:
+            if t in _ASYNC_THREADS:
+                _ASYNC_THREADS.remove(t)
+        errors = list(_ASYNC_ERRORS)
+        del _ASYNC_ERRORS[:]
+    if errors:
+        if len(errors) == 1:
+            raise RuntimeError("async checkpoint save failed") from errors[0]
+        detail = "; ".join(f"{type(e).__name__}: {e}" for e in errors)
+        raise RuntimeError(
+            f"{len(errors)} async checkpoint saves failed: "
+            f"{detail}") from errors[0]
 
 
 atexit.register(wait_async_save)  # don't kill a mid-write daemon at exit
@@ -162,7 +192,8 @@ def save_state_dict(state_dict, path, process_group=None,
                     arrays[key] = v.copy() if async_save else v
                     entry["chunks"].append(
                         {"offset": [0] * v.ndim, "shape": list(v.shape),
-                         "file": shard_file, "key": key})
+                         "file": shard_file, "key": key,
+                         "crc32": _crc32(arrays[key])})
                 meta["tensors"][k] = entry
                 continue
             entry = {"shape": list(v.shape), "dtype": str(v.dtype),
@@ -177,7 +208,8 @@ def save_state_dict(state_dict, path, process_group=None,
                     else data
                 entry["chunks"].append({"offset": list(offset),
                                         "shape": list(cshape),
-                                        "file": shard_file, "key": key})
+                                        "file": shard_file, "key": key,
+                                        "crc32": _crc32(arrays[key])})
             meta["tensors"][k] = entry
         else:
             meta["tensors"][k] = {"value": v if not isinstance(
@@ -209,8 +241,9 @@ def save_state_dict(state_dict, path, process_group=None,
             except BaseException as e:  # surfaced by wait_async_save()
                 _ASYNC_ERRORS.append(e)
         t = threading.Thread(target=_guarded, daemon=True)
+        with _ASYNC_LOCK:
+            _ASYNC_THREADS.append(t)
         t.start()
-        _ASYNC_THREADS.append(t)
     else:
         _write()
 
@@ -286,7 +319,19 @@ class _ChunkReader:
         if (fname, key) not in self._decoded:
             if fname not in self._files:
                 self._files[fname] = np.load(os.path.join(self.path, fname))
-            self._decoded[(fname, key)] = self._files[fname][key]
+            arr = self._files[fname][key]
+            want = chunk.get("crc32")
+            if want is not None:
+                got = _crc32(arr)
+                if got != int(want):
+                    from ...profiler import counters as _counters
+                    _counters.inc("resilience.corrupt_detected")
+                    raise CheckpointCorrupt(
+                        f"checksum mismatch for chunk {key!r} in "
+                        f"{os.path.join(self.path, fname)}: stored "
+                        f"crc32={int(want)}, computed crc32={got} — the "
+                        "checkpoint bytes on disk are corrupt")
+            self._decoded[(fname, key)] = arr
         return self._decoded[(fname, key)]
 
     def clear_cache(self):

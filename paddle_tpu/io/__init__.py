@@ -470,15 +470,26 @@ class DevicePrefetcher:
         loader = paddle_tpu.io.DataLoader(ds, batch_size=64)
         for x, y in paddle_tpu.io.DevicePrefetcher(loader, depth=2):
             loss = compiled_step(x, y)
+
+    Resume cursor (``resilience.CheckpointManager``): ``consumed`` counts
+    batches *delivered to the consumer* (buffered-but-undelivered batches
+    don't count — they were never trained on), so it is the exact
+    data-iterator offset to checkpoint.  Passing it back as
+    ``start_offset`` on a fresh prefetcher over a deterministic loader
+    replays the epoch to that position: skipped batches are pulled from the
+    loader but neither staged on device nor delivered, and are counted
+    under ``io.skipped_batches``.
     """
 
-    def __init__(self, loader, depth=2, device=None):
+    def __init__(self, loader, depth=2, device=None, start_offset=0):
         self.loader = loader
         self.depth = max(1, int(depth))
         self.device = device
+        self.start_offset = max(0, int(start_offset))
+        self.consumed = self.start_offset
 
     def __len__(self):
-        return len(self.loader)
+        return max(0, len(self.loader) - self.start_offset)
 
     def _stage(self, batch):
         import jax
@@ -501,6 +512,19 @@ class DevicePrefetcher:
         from collections import deque
         buf = deque()
         it = iter(self.loader)
+        self.consumed = self.start_offset
+        if self.start_offset:
+            # replay-to-offset: drain skipped batches host-side only — no
+            # device_put, no staging, just advancing the loader cursor
+            with _trace.span("io.skip_replay"):
+                skipped = 0
+                for _ in range(self.start_offset):
+                    try:
+                        next(it)
+                    except StopIteration:
+                        break
+                    skipped += 1
+                _counters.inc("io.skipped_batches", skipped)
         while True:
             with _trace.span("io.prefetcher"):
                 t0 = _time.perf_counter_ns()
@@ -518,8 +542,10 @@ class DevicePrefetcher:
                     staged = self._stage(batch)
                 buf.append(staged)
             if len(buf) >= self.depth:
+                self.consumed += 1
                 yield buf.popleft()
         while buf:
+            self.consumed += 1
             yield buf.popleft()
 
 
@@ -563,16 +589,21 @@ class StackingPrefetcher:
     steps.
     """
 
-    def __init__(self, loader, k, depth=None, device=None):
+    def __init__(self, loader, k, depth=None, device=None, start_offset=0):
         self.loader = loader
         self.k = max(1, int(k))
         # double-buffer in window units: the next window's batches stage
         # while the current window runs
         depth = 2 * self.k if depth is None else max(1, int(depth))
-        self._pref = DevicePrefetcher(loader, depth=depth, device=device)
+        self.start_offset = max(0, int(start_offset))
+        self._pref = DevicePrefetcher(loader, depth=depth, device=device,
+                                      start_offset=self.start_offset)
+        # resume cursor in UNDERLYING batches (k per full window), counted
+        # when a window is delivered — matches DevicePrefetcher.consumed
+        self.consumed = self.start_offset
 
     def __len__(self):
-        n = len(self.loader)
+        n = max(0, len(self.loader) - self.start_offset)
         return (n + self.k - 1) // self.k
 
     @staticmethod
@@ -606,11 +637,13 @@ class StackingPrefetcher:
             _counters.inc("io.stack_batches", len(batches))
             stacked = self._stack(batches)
             args = stacked if isinstance(stacked, tuple) else (stacked,)
+            self.consumed += len(batches)
             return Window(args, len(batches))
 
     def __iter__(self):
         pending = []
         spec0 = None
+        self.consumed = self.start_offset
         for staged in self._pref:
             s = self._spec(staged)
             if pending and s != spec0:

@@ -381,6 +381,36 @@ class CompiledTrainStep:
         self.sync()
         self._state = None
 
+    def export_resume_state(self):
+        """Checkpoint hook (``resilience.CheckpointManager``): converge the
+        python model/optimizer/scaler objects with the device-resident state
+        via ONE counter-gated :meth:`sync`, and return the in-graph RNG
+        carry key as raw key data (uint32 ndarray) so an exact-resume
+        restore can continue the per-dispatch key chain bit-identically."""
+        import numpy as np
+        self._ensure_state()
+        self.sync()
+        return np.array(jax.random.key_data(self._state[4]), copy=True)
+
+    def restore_resume_state(self, rng_carry=None):
+        """Rebuild the device-resident state from the (just restored) python
+        model/optimizer/scaler objects and install the saved RNG carry key.
+
+        The re-hydrate draws (and discards) one key from the global
+        generator, so callers restoring ``paddle.get_rng_state()`` must do
+        so AFTER this call for bit-identical resume.  The lr dispatch
+        caches are reset so the first resumed dispatch re-reads the
+        (restored) scheduler."""
+        self._state = None
+        self._hydrate()
+        if rng_carry is not None:
+            params, buffers, opt_state, sstate, _ = self._state
+            key = jax.random.wrap_key_data(
+                jnp.asarray(rng_carry, jnp.uint32))
+            self._state = (params, buffers, opt_state, sstate, key)
+        self._lr_host = self._lr_dev = None
+        self._lrs_host = self._lrs_dev = None
+
     def _step_body(self, check_nan_inf, params, buffers, opt_state, lr,
                    rng_key, sstate, args):
         """One training step as a pure traceable function — the body shared

@@ -229,6 +229,24 @@ class ReduceOnPlateau(LRScheduler):
     def get_lr(self):
         return self.last_lr
 
+    # best/num_bad/cooldown_counter ARE the schedule position for a
+    # metrics-driven scheduler — without them a restored run re-enters
+    # cooldown/patience from scratch and diverges from the uninterrupted one
+    def state_dict(self):
+        state = super().state_dict()
+        state.update({"best": self.best, "num_bad": self.num_bad,
+                      "cooldown_counter": self.cooldown_counter})
+        return state
+
+    def set_state_dict(self, state):
+        super().set_state_dict(state)
+        self.best = state.get("best", self.best)
+        self.num_bad = int(state.get("num_bad", self.num_bad))
+        self.cooldown_counter = int(state.get("cooldown_counter",
+                                              self.cooldown_counter))
+
+    set_dict = set_state_dict
+
 
 class CosineAnnealingDecay(LRScheduler):
     def __init__(self, learning_rate, T_max, eta_min=0, last_epoch=-1,

@@ -26,7 +26,15 @@ Well-known names (see README "Observability" for the full table):
   serving.decode_tokens / serving.evictions / serving.evictions.<reason>
   serving.retraces (serving program compiles; 0 in steady state)
   serving.queue_wait_ns
+  serving.deadline_expired (queued past-deadline, evicted pre-prefill)
+  serving.request_errors (poisoned requests contained to reason "error")
   serving.slot_occupancy / serving.prefill_programs (gauges)
+  resilience.saves / resilience.save_ms / resilience.restores
+  resilience.retries / resilience.corrupt_detected
+  resilience.recoveries / resilience.recovered.<ExcType>
+  resilience.save_failures / resilience.gc_removed
+  resilience.faults_injected / resilience.faults_injected.<site>
+  io.skipped_batches (replay-to-offset batches skipped on resume)
 """
 
 from __future__ import annotations

@@ -1,0 +1,36 @@
+"""paddle_tpu.resilience — fault-tolerant training.
+
+Reference analogue: Paddle's fleet/elastic stack (recoverability as a
+first-class subsystem); TPU-idiomatic design follows the Orbax/Levanter
+pattern — async, atomic, garbage-collected checkpoints with exact-resume
+semantics.
+
+Three pieces:
+
+* :class:`CheckpointManager` (``manager.py``) — snapshots the *complete*
+  training state of a ``jit.CompiledTrainStep`` (params/buffers/opt-state/
+  scaler/scheduler/RNG chain/iterator cursor) with ONE counter-gated
+  ``sync()`` per save, writes through ``distributed/checkpoint`` with
+  atomic directory commit, per-chunk crc32 verified on load, retry with
+  exponential backoff, keep-last-N GC, and async saves that overlap the
+  next fused window.
+* :class:`FaultTolerantTrainer` (``trainer.py``) — a loop that catches
+  faults, restores the last good checkpoint, replays the data iterator to
+  the exact offset, and continues **bit-identically**.
+* ``faultinject`` — a deterministic, flag-driven fault schedule
+  (``FLAGS_fault_schedule``) the tests use to prove every recovery path.
+
+Counters: ``resilience.saves / save_ms / restores / retries /
+corrupt_detected / recoveries / save_failures / faults_injected /
+gc_removed`` (+ ``io.skipped_batches`` from replay).
+"""
+
+from . import faultinject  # noqa: F401
+from .manager import (CheckpointCorrupt, CheckpointManager,  # noqa: F401
+                      CheckpointWriteError)
+from .trainer import FaultTolerantTrainer, NonFiniteLossError  # noqa: F401
+
+__all__ = [
+    "CheckpointManager", "CheckpointCorrupt", "CheckpointWriteError",
+    "FaultTolerantTrainer", "NonFiniteLossError", "faultinject",
+]

@@ -1,0 +1,213 @@
+"""Deterministic, flag-driven fault injection for resilience testing.
+
+A process-global *schedule* maps ``(site, index)`` to a number of times the
+fault should fire.  Instrumented code calls :func:`maybe_fault(site, index)`
+at well-known sites; when the schedule has a live entry for that exact
+``(site, index)`` pair the site's exception is raised (and the entry's
+remaining count decremented), otherwise the call is a near-free no-op —
+``maybe_fault`` returns immediately when no schedule is active, so shipping
+the hooks in production paths costs one dict truthiness check.
+
+Schedule specs are strings so they can ride in a flag or environment
+variable (``FLAGS_fault_schedule``)::
+
+    ckpt_write@1*2;preempt@4;nan_loss@7;loader@5
+
+means: the checkpoint write for save ordinal 1 raises a (transient)
+``InjectedWriteError`` twice (attempts 1 and 2 fail, attempt 3 succeeds),
+training step 4 ends in a :class:`SimulatedPreemption`, the loss of step 7
+is poisoned to NaN, and fetching the batch for step 5 raises
+``InjectedLoaderError``.  Every fault is keyed on a deterministic ordinal
+(save number, global step, request id) so the same schedule reproduces the
+same failure sequence run after run.
+
+Well-known sites
+----------------
+
+===================  ====================================================
+``ckpt_write``       transient IOError inside the checkpoint write;
+                     index = save ordinal.  Retried by CheckpointManager.
+``ckpt_crash``       hard crash between chunk write and manifest commit;
+                     index = save ordinal.  NOT retried — models a writer
+                     killed mid-save (atomicity test).
+``preempt``          SimulatedPreemption after a training step; index =
+                     global step.  The SIGTERM-shaped fault.
+``loader``           InjectedLoaderError fetching a batch; index = global
+                     step at which the batch would be consumed.
+``nan_loss``         poisons the step's batch so the loss goes NaN; index
+                     = global step.  Queried via :func:`take` (the trainer
+                     poisons the input rather than raising).
+``serving_prefill``  per-request failure inside LLMEngine admission;
+                     index = request id.
+===================  ====================================================
+
+Every fired fault is appended to :data:`fired` (``(site, index)`` tuples)
+and counted under ``resilience.faults_injected`` so tests and gates can
+assert exactly which faults fired.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+from ..core import flags as _flags
+from ..profiler import counters as _counters
+
+__all__ = [
+    "InjectedFault", "InjectedWriteError", "InjectedLoaderError",
+    "SimulatedCrash", "SimulatedPreemption",
+    "set_schedule", "clear", "active", "maybe_fault", "take", "fired",
+    "fault_schedule", "install_sigterm_handler",
+]
+
+
+class InjectedFault(Exception):
+    """Base class for all injected faults (recoverable by the trainer)."""
+
+
+class InjectedWriteError(InjectedFault, IOError):
+    """Transient checkpoint-write failure (retryable: an IOError)."""
+
+
+class InjectedLoaderError(InjectedFault):
+    """Data loader raised while fetching a batch."""
+
+
+class SimulatedPreemption(InjectedFault):
+    """The SIGTERM-shaped fault: the worker is being preempted."""
+
+
+class SimulatedCrash(BaseException):
+    """Hard kill mid-operation.  Deliberately NOT an ``Exception`` subclass
+    so generic ``except Exception`` recovery/retry paths cannot swallow it —
+    it models the process dying, and must unwind like ``KeyboardInterrupt``.
+    """
+
+
+_EXC = {
+    "ckpt_write": InjectedWriteError,
+    "ckpt_crash": SimulatedCrash,
+    "preempt": SimulatedPreemption,
+    "loader": InjectedLoaderError,
+    "serving_prefill": InjectedFault,
+}
+
+_LOCK = threading.Lock()
+_SCHEDULE: dict = {}   # (site, index) -> remaining fire count
+fired: list = []       # (site, index) log of every fault that fired
+
+
+def _parse(spec):
+    """``"site@index[*count]; ..."`` -> {(site, index): count}."""
+    sched = {}
+    for entry in str(spec).replace(",", ";").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        try:
+            site, rest = entry.split("@", 1)
+            if "*" in rest:
+                idx, count = rest.split("*", 1)
+            else:
+                idx, count = rest, 1
+            sched[(site.strip(), int(idx))] = int(count)
+        except ValueError:
+            raise ValueError(
+                f"bad fault schedule entry {entry!r}; want "
+                "'site@index' or 'site@index*count'") from None
+    return sched
+
+
+def set_schedule(spec):
+    """Install a fault schedule: a spec string, a ``{(site, index): count}``
+    dict, or ``None``/``""`` to clear."""
+    global _SCHEDULE
+    with _LOCK:
+        if not spec:
+            _SCHEDULE = {}
+        elif isinstance(spec, dict):
+            _SCHEDULE = {(str(s), int(i)): int(c)
+                         for (s, i), c in spec.items()}
+        else:
+            _SCHEDULE = _parse(spec)
+        del fired[:]
+
+
+def clear():
+    set_schedule(None)
+
+
+def active():
+    return bool(_SCHEDULE)
+
+
+def take(site, index):
+    """Consume one scheduled firing of ``(site, index)``.  Returns True if
+    the fault was scheduled (caller applies the effect itself — e.g. the
+    trainer poisoning a batch to NaN), False otherwise."""
+    if not _SCHEDULE:
+        return False
+    key = (str(site), int(index))
+    with _LOCK:
+        remaining = _SCHEDULE.get(key, 0)
+        if remaining <= 0:
+            return False
+        if remaining == 1:
+            del _SCHEDULE[key]
+        else:
+            _SCHEDULE[key] = remaining - 1
+        fired.append(key)
+    _counters.inc("resilience.faults_injected")
+    _counters.inc(f"resilience.faults_injected.{site}")
+    return True
+
+
+def maybe_fault(site, index):
+    """Raise the site's exception if the schedule says ``(site, index)``
+    should fail now; no-op (one dict check) otherwise."""
+    if not _SCHEDULE:
+        return
+    if take(site, index):
+        exc = _EXC.get(str(site), InjectedFault)
+        raise exc(f"injected fault: {site}@{index}")
+
+
+class fault_schedule:
+    """Context manager installing a schedule for the enclosed block::
+
+        with faultinject.fault_schedule("preempt@4"):
+            trainer.run()
+    """
+
+    def __init__(self, spec):
+        self._spec = spec
+
+    def __enter__(self):
+        set_schedule(self._spec)
+        return self
+
+    def __exit__(self, *exc):
+        clear()
+        return False
+
+
+def install_sigterm_handler():
+    """Convert a real SIGTERM into a :class:`SimulatedPreemption` raised in
+    the main thread, so a preempting scheduler flows through the same
+    recovery path as the injected fault.  Returns the previous handler."""
+    def _handler(signum, frame):
+        raise SimulatedPreemption(f"SIGTERM received (pid {os.getpid()})")
+    return signal.signal(signal.SIGTERM, _handler)
+
+
+# Flag/env driven schedule: FLAGS_fault_schedule=preempt@4 python train.py
+_flags.define_flag(
+    "FLAGS_fault_schedule", "",
+    "Deterministic fault-injection schedule for resilience testing: "
+    "'site@index[*count];...' with sites ckpt_write/ckpt_crash/preempt/"
+    "loader/nan_loss/serving_prefill (see paddle_tpu.resilience."
+    "faultinject).  Empty disables injection.")
+_flags.register_flag_observer("FLAGS_fault_schedule",
+                              lambda v: set_schedule(v or None))

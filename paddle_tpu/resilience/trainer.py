@@ -1,0 +1,210 @@
+"""FaultTolerantTrainer: a training loop that survives faults with
+bit-identical resume.
+
+The loop drives a ``jit.CompiledTrainStep`` from a deterministic data
+loader (through ``io.DevicePrefetcher`` / ``io.StackingPrefetcher`` for
+``fused_steps > 1``), checkpoints the complete training state every
+``save_every`` steps through a :class:`~.manager.CheckpointManager`, and on
+a recoverable fault — preemption, loader exception, non-finite loss,
+``FloatingPointError`` from the NaN guard — restores the last good
+checkpoint, replays the data iterator to the exact saved offset, and
+continues.  Because the checkpoint captures params/opt-state/scaler/
+scheduler/RNG-chain/iterator-cursor *completely*, and the replayed batches
+are bit-identical (deterministic loader + ``start_offset`` skip), the
+resumed loss trajectory is bit-identical to an uninterrupted run.
+
+Determinism contract: ``loader_factory(epoch)`` must yield the same batches
+in the same order every time it is called with the same epoch (e.g. a
+``DataLoader`` with ``shuffle=False``, or a seeded per-epoch sampler).
+
+Fault injection (``resilience.faultinject``) hooks: ``loader`` (raises
+fetching the batch for step k), ``preempt`` (SimulatedPreemption after
+step k), ``nan_loss`` (poisons step k's batch so the loss goes NaN).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..io import DevicePrefetcher, StackingPrefetcher, Window
+from ..profiler import counters as _counters
+from ..profiler import host_tracer as _trace
+from . import faultinject as _fi
+
+__all__ = ["FaultTolerantTrainer", "NonFiniteLossError"]
+
+
+class NonFiniteLossError(RuntimeError):
+    """A training step produced a NaN/Inf loss (poisoned batch)."""
+
+
+def _poison_leaf(t):
+    """NaN-fill floating leaves (int leaves — e.g. token ids — pass
+    through; the loss itself goes NaN through the float path)."""
+    from ..core.tensor import Tensor
+    if isinstance(t, Tensor) and jnp.issubdtype(t._data.dtype, jnp.floating):
+        return Tensor._wrap(jnp.full_like(t._data, jnp.nan))
+    return t
+
+
+class FaultTolerantTrainer:
+    """Run ``train_step`` over ``loader_factory`` with automatic recovery.
+
+    Parameters
+    ----------
+    train_step: a ``jit.CompiledTrainStep``.
+    loader_factory: ``callable(epoch) -> iterable`` of batches (tuples of
+        Tensors), or a re-iterable loader used for every epoch.  MUST be
+        deterministic per epoch (see module docstring).
+    manager: a :class:`~.manager.CheckpointManager`.
+    scheduler: optional LRScheduler, advanced once per training step after
+        the step (fused windows advance it ``k`` times).
+    epochs / max_steps: run length (whichever is hit first).
+    save_every: checkpoint every N global steps (window-aligned); the
+        manager's ``async_save`` decides whether the write overlaps the
+        next window.  A step-0 checkpoint is always written first so a
+        fault before the first periodic save can still recover.
+    max_recoveries: give up (re-raise) after this many recoveries.
+    recoverable: exception classes that trigger restore-and-resume; the
+        default covers injected faults, the jit NaN guard
+        (``FloatingPointError``) and :class:`NonFiniteLossError`.
+        ``faultinject.SimulatedCrash`` is a ``BaseException`` and is never
+        caught — a crash kills the process, recovery happens on restart.
+    """
+
+    def __init__(self, train_step, loader_factory, manager, *,
+                 scheduler=None, epochs=1, max_steps=None, save_every=8,
+                 max_recoveries=8, prefetch_depth=2, recoverable=None,
+                 install_sigterm=False):
+        self.step = train_step
+        self.loader_factory = loader_factory
+        self.manager = manager
+        self.scheduler = scheduler
+        self.epochs = int(epochs)
+        self.max_steps = None if max_steps is None else int(max_steps)
+        self.save_every = int(save_every)
+        self.max_recoveries = int(max_recoveries)
+        self.prefetch_depth = int(prefetch_depth)
+        self.recoverable = tuple(recoverable) if recoverable is not None \
+            else (_fi.InjectedFault, FloatingPointError, NonFiniteLossError)
+        if install_sigterm:
+            _fi.install_sigterm_handler()
+        self.global_step = 0
+        self.losses = {}  # 1-based global step -> float loss
+        self.recoveries = 0
+        self._epoch = 0
+        self._offset = 0  # batches consumed in the current epoch
+        self._last_saved = -1
+
+    # -- plumbing ------------------------------------------------------------
+    def _make_loader(self, epoch):
+        lf = self.loader_factory
+        return lf(epoch) if callable(lf) else lf
+
+    def _make_prefetcher(self, loader, offset):
+        k = int(getattr(self.step, "fused_steps", 1))
+        if k > 1:
+            return StackingPrefetcher(loader, k, start_offset=offset)
+        return DevicePrefetcher(loader, depth=self.prefetch_depth,
+                                start_offset=offset)
+
+    def _save(self, offset, blocking=None):
+        self.manager.save(self.step, self.global_step,
+                          scheduler=self.scheduler,
+                          cursor={"epoch": self._epoch, "offset": offset},
+                          blocking=blocking)
+        self._last_saved = self.global_step
+
+    def _apply(self, info):
+        self.global_step = int(info["step"])
+        cur = info["cursor"]
+        self._epoch = int(cur.get("epoch", 0))
+        self._offset = int(cur.get("offset", 0))
+        self._last_saved = self.global_step
+
+    def _recover(self, exc):
+        _counters.inc("resilience.recoveries")
+        _counters.inc(f"resilience.recovered.{type(exc).__name__}")
+        # a concurrently failing async save must not mask the recovery —
+        # the checkpoint set on disk is what matters now
+        self.manager.wait(suppress=True)
+        info = self.manager.restore(self.step, scheduler=self.scheduler)
+        if info is None:
+            raise exc
+        self._apply(info)
+
+    # -- the loop ------------------------------------------------------------
+    def run(self):
+        """Train to completion, recovering from faults.  Returns the
+        ``{global_step: loss}`` dict (replayed steps overwrite their own
+        earlier entries with bit-identical values)."""
+        if self.manager.latest() is not None:
+            info = self.manager.restore(self.step, scheduler=self.scheduler)
+            self._apply(info)
+        else:
+            self._save(self._offset, blocking=True)  # guaranteed restore point
+        while True:
+            try:
+                self._train()
+                break
+            except self.recoverable as exc:
+                self.recoveries += 1
+                if self.recoveries > self.max_recoveries:
+                    raise
+                self._recover(exc)
+        self.manager.wait()
+        return self.losses
+
+    def _done(self):
+        return self.max_steps is not None and self.global_step >= self.max_steps
+
+    def _train(self):
+        while self._epoch < self.epochs and not self._done():
+            loader = self._make_loader(self._epoch)
+            pref = self._make_prefetcher(loader, self._offset)
+            for item in pref:
+                self._one_window(item, pref.consumed)
+                self._offset = pref.consumed
+                if self._done():
+                    break
+            if not self._done():
+                self._epoch += 1
+                self._offset = 0
+        if self.global_step != self._last_saved:
+            self._save(self._offset, blocking=True)
+
+    def _one_window(self, item, consumed_after):
+        gs0 = self.global_step
+        # fault site: the loader raised while fetching step gs0+1's batch
+        _fi.maybe_fault("loader", gs0 + 1)
+        k = item.k if isinstance(item, Window) else 1
+        if any(_fi.take("nan_loss", gs0 + i + 1) for i in range(k)):
+            if isinstance(item, Window):
+                item = Window(tuple(_poison_leaf(t) for t in item), item.k)
+            else:
+                item = tuple(_poison_leaf(t) for t in item)
+        with _trace.span("resilience.window"):
+            if isinstance(item, Window):
+                losses = self.step(item)
+            elif isinstance(item, (tuple, list)):
+                losses = self.step(*item)
+            else:
+                losses = self.step(item)
+        vals = np.atleast_1d(np.asarray(losses.numpy()))
+        if not np.all(np.isfinite(vals)):
+            raise NonFiniteLossError(
+                f"non-finite loss at steps {gs0 + 1}..{gs0 + k}: {vals}")
+        for i in range(k):
+            self.losses[gs0 + i + 1] = float(vals[i])
+        if self.scheduler is not None:
+            for _ in range(k):
+                self.scheduler.step()
+        self.global_step = gs0 + k
+        if self.save_every > 0 and \
+                self.global_step - self._last_saved >= self.save_every:
+            self._save(consumed_after)
+        # fault site: preemption lands after the step (and after any
+        # periodic save), like a SIGTERM between steps
+        for i in range(k):
+            _fi.maybe_fault("preempt", gs0 + i + 1)

@@ -67,7 +67,7 @@ class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "do_sample",
                  "temperature", "top_k", "top_p", "eos_token_id", "seed",
                  "state", "finish_reason", "tokens", "slot", "arrival_ns",
-                 "deadline", "_cancel", "_engine")
+                 "deadline", "_cancel", "_engine", "error")
 
     def __init__(self, rid, prompt, max_new_tokens, do_sample, temperature,
                  top_k, top_p, eos_token_id, seed, deadline, engine):
@@ -81,7 +81,9 @@ class Request:
         self.eos_token_id = eos_token_id
         self.seed = seed
         self.state = "queued"     # queued | running | finished
-        self.finish_reason = None  # eos | length | deadline | cancelled
+        # eos | length | deadline | cancelled | error
+        self.finish_reason = None
+        self.error = None         # the exception, when finish_reason="error"
         self.tokens = []          # generated tokens (includes eos if hit)
         self.slot = None
         self.arrival_ns = time.monotonic_ns()
@@ -320,7 +322,9 @@ class LLMEngine:
         events.append({"type": "finished", "request": req, "reason": reason})
 
     def _sweep(self, events):
-        """Evict cancelled / past-deadline active requests."""
+        """Evict cancelled / past-deadline requests — active slots AND the
+        admission queue, so a request whose deadline lapsed while queued is
+        evicted here instead of spending a prefill launch in ``_admit``."""
         now = time.monotonic()
         for req in list(self._slots):
             if req is None:
@@ -328,6 +332,22 @@ class LLMEngine:
             if req._cancel:
                 self._finish(req, "cancelled", events)
             elif req.deadline is not None and now > req.deadline:
+                self._finish(req, "deadline", events)
+        expired = []
+        with self._cond:
+            dead = [r for r in self._queue
+                    if r._cancel or (r.deadline is not None
+                                     and now > r.deadline)]
+            if dead:
+                for r in dead:
+                    self._queue.remove(r)
+                expired = dead
+                self._cond.notify_all()
+        for req in expired:
+            if req._cancel:
+                self._finish(req, "cancelled", events)
+            else:
+                counters.inc("serving.deadline_expired")
                 self._finish(req, "deadline", events)
 
     def _emit(self, req, tok, events):
@@ -351,24 +371,37 @@ class LLMEngine:
                 self._finish(req, "cancelled", events)
                 continue
             if req.deadline is not None and now > req.deadline:
+                counters.inc("serving.deadline_expired")
                 self._finish(req, "deadline", events)
                 continue
             counters.inc("serving.queue_wait_ns",
                          time.monotonic_ns() - req.arrival_ns)
             slot = self._free.pop()
-            T = int(req.prompt.shape[0])
-            bucket = bucket_length(T, self.min_bucket, self.max_seq_len)
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :T] = req.prompt
-            key_data = np.asarray(
-                jax.random.key_data(jax.random.key(req.seed)))
-            with span("serving.prefill"):
-                kc, vc, tok, new_key = self._prefill_for(bucket)(
-                    self._w, jnp.asarray(ids), np.int32(T), key_data,
-                    np.bool_(req.do_sample), np.float32(req.temperature),
-                    np.int32(req.top_k), np.float32(req.top_p))
-                self._ck, self._cv = self._insert_for(bucket)(
-                    self._ck, self._cv, kc, vc, np.int32(slot))
+            try:
+                from ..resilience import faultinject as _fi
+                _fi.maybe_fault("serving_prefill", req.rid)
+                T = int(req.prompt.shape[0])
+                bucket = bucket_length(T, self.min_bucket, self.max_seq_len)
+                ids = np.zeros((1, bucket), np.int32)
+                ids[0, :T] = req.prompt
+                key_data = np.asarray(
+                    jax.random.key_data(jax.random.key(req.seed)))
+                with span("serving.prefill"):
+                    kc, vc, tok, new_key = self._prefill_for(bucket)(
+                        self._w, jnp.asarray(ids), np.int32(T), key_data,
+                        np.bool_(req.do_sample), np.float32(req.temperature),
+                        np.int32(req.top_k), np.float32(req.top_p))
+                    self._ck, self._cv = self._insert_for(bucket)(
+                        self._ck, self._cv, kc, vc, np.int32(slot))
+            except Exception as e:
+                # a poisoned request (bad prompt, injected fault, prefill
+                # blow-up) must not kill the engine loop: contain it to
+                # finish_reason="error" and hand the slot right back
+                self._free.append(slot)
+                req.error = e
+                counters.inc("serving.request_errors")
+                self._finish(req, "error", events)
+                continue
             counters.inc("serving.prefill_batches")
             req.state = "running"
             req.slot = slot

@@ -12,6 +12,14 @@ gates the launch economics: a steady K-step window must be exactly ONE
 XLA dispatch (``jit.host.dispatches == jit.steps / K``) with zero
 retraces.
 
+A checkpointed-run phase gates the resilience contract: async
+``resilience.CheckpointManager`` saves interleaved with fused windows
+must cost exactly ONE counter-gated ``jit.syncs`` (+ its
+``bind_layer_state``/``bind_optimizer_state`` pair) per save and nothing
+else — zero retraces, zero rehydrates, zero ``layer_state``/
+``optimizer_state`` host reads; the disk write overlaps the next window
+on a background thread.
+
 A serving phase runs mixed-length staggered requests through
 ``serving.LLMEngine`` and asserts the outputs are TOKEN-IDENTICAL to
 sequential per-request ``GPT.generate``; it reports decode tokens/s for
@@ -82,8 +90,33 @@ def run():
     fused_dispatches = fused.get("jit.host.dispatches", 0)
     fused_steps_done = fused.get("jit.steps", 0)
 
-    # ---- serving: engine output must match sequential generate ----------
+    # ---- resilience: async checkpoints overlap the next fused window ----
+    import tempfile
     import time
+    from paddle_tpu.resilience import CheckpointManager
+
+    ckpt_saves = 2
+    with tempfile.TemporaryDirectory() as ckdir:
+        mgr = CheckpointManager(ckdir, keep_last=2, async_save=True)
+        rbefore = counters.snapshot()
+        t0 = time.perf_counter()
+        for i in range(ckpt_saves):
+            # snapshot (one sync + D2H copies) on this thread, disk write
+            # on a daemon thread — the next fused window overlaps it
+            mgr.save(fstep, (i + 1) * fused_k, blocking=False)
+            fstep(win).numpy()
+        mgr.wait()
+        ckpt_wall_s = time.perf_counter() - t0
+        rdelta = counters.delta(rbefore)
+    ckpt_host_delta = {k: rdelta.get(k, 0) for k in host_keys}
+    # budget: exactly one counter-gated sync (one bind pair) per save
+    ckpt_extra_syncs = (
+        sum(ckpt_host_delta.values())
+        - rdelta.get("jit.syncs", 0)
+        - rdelta.get("jit.host.bind_layer_state", 0)
+        - rdelta.get("jit.host.bind_optimizer_state", 0))
+
+    # ---- serving: engine output must match sequential generate ----------
     from paddle_tpu.serving import LLMEngine
 
     paddle.seed(0)
@@ -137,6 +170,13 @@ def run():
               "fused_window_steps": fused_steps_done,
               "fused_window_retraces": fused.get("jit.traces", 0),
               "fused_losses": flosses,
+              "ckpt_async_saves": rdelta.get("resilience.saves", 0),
+              "ckpt_save_ms": rdelta.get("resilience.save_ms", 0),
+              "ckpt_wall_s": round(ckpt_wall_s, 4),
+              "ckpt_syncs": rdelta.get("jit.syncs", 0),
+              "ckpt_retraces": rdelta.get("jit.traces", 0),
+              "ckpt_rehydrates": rdelta.get("jit.hydrates", 0),
+              "ckpt_extra_host_syncs": ckpt_extra_syncs,
               "serve_requests": len(prompts),
               "serve_decode_tokens": decode_tokens,
               "serve_decode_tokens_per_sec": round(serve_tps, 1),
@@ -168,6 +208,20 @@ def run():
         raise AssertionError(
             "steady fused window retraced: jit.traces += "
             f"{result['fused_window_retraces']}")
+    if result["ckpt_async_saves"] != ckpt_saves or \
+            rdelta.get("resilience.save_failures", 0) != 0:
+        raise AssertionError(
+            f"checkpointed run: expected {ckpt_saves} clean async saves, "
+            f"got {result['ckpt_async_saves']} (failures: "
+            f"{rdelta.get('resilience.save_failures', 0)})")
+    if result["ckpt_syncs"] != ckpt_saves or result["ckpt_retraces"] != 0 \
+            or result["ckpt_rehydrates"] != 0 or ckpt_extra_syncs != 0:
+        raise AssertionError(
+            "checkpointed run broke the one-sync-per-save budget: "
+            f"jit.syncs += {result['ckpt_syncs']} (want {ckpt_saves}), "
+            f"retraces {result['ckpt_retraces']}, rehydrates "
+            f"{result['ckpt_rehydrates']}, extra host syncs "
+            f"{ckpt_extra_syncs}: {ckpt_host_delta}")
     if not all(np.isfinite(l) for l in losses + flosses):
         raise AssertionError(
             f"non-finite loss in smoke run: {losses} / {flosses}")

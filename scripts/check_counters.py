@@ -24,6 +24,15 @@ buckets must show ``serving.retraces == 0`` and zero jit.* trace/hydrate/
 host-bind movement — continuous batching reaches the same
 zero-python-overhead steady state as training.
 
+A fourth phase gates checkpointed training (``paddle_tpu.resilience``):
+a warm step interleaved with ``CheckpointManager.save`` calls must show
+zero retraces/rehydrates and zero host sync work beyond the ONE
+counter-gated ``sync()`` per save (``jit.syncs == saves``, with exactly
+one ``bind_layer_state``/``bind_optimizer_state`` pair each and zero
+``layer_state``/``optimizer_state`` re-reads); then a
+``FaultTolerantTrainer`` run under a deterministic fault schedule must
+show ``resilience.restores == injected preemptions``.
+
 Prints one JSON line; raises AssertionError on any violation.  Wired as a
 tier-1 test via tests/test_profiler.py.  Run directly:
 ``python scripts/check_counters.py``.
@@ -155,6 +164,88 @@ def run():
                        for k, want in sinvariants.items()
                        if ssteady.get(k, 0) != want})
 
+    # ---- resilience gate 1: saves cost ONE sync each, nothing else ------
+    import tempfile
+    from paddle_tpu.resilience import (CheckpointManager,
+                                       FaultTolerantTrainer, faultinject)
+
+    CKPT_SAVES = 2
+    CKPT_STEPS_PER_SAVE = 2
+    paddle.seed(0)
+    cmodel = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+    copt = paddle.optimizer.AdamW(1e-3, parameters=cmodel.parameters())
+    cstep = pjit.CompiledTrainStep(cmodel, loss_fn, copt)
+    for _ in range(WARMUP):
+        cstep(x, y).numpy()
+    with tempfile.TemporaryDirectory() as ckdir:
+        mgr = CheckpointManager(ckdir, keep_last=2)
+        cbefore = counters.snapshot()
+        for i in range(CKPT_SAVES):
+            for _ in range(CKPT_STEPS_PER_SAVE):
+                cstep(x, y).numpy()
+            mgr.save(cstep, (i + 1) * CKPT_STEPS_PER_SAVE, blocking=True)
+        csteady = counters.delta(cbefore)
+
+    ckpt_steps = CKPT_SAVES * CKPT_STEPS_PER_SAVE
+    cinvariants = {
+        "jit.traces": 0,
+        "jit.hydrates": 0,
+        "jit.cache_misses": 0,
+        "jit.steps": ckpt_steps,
+        "jit.host.dispatches": ckpt_steps,
+        "resilience.saves": CKPT_SAVES,
+        # THE budget: one counter-gated sync per save, nothing more
+        "jit.syncs": CKPT_SAVES,
+        "jit.host.bind_layer_state": CKPT_SAVES,
+        "jit.host.bind_optimizer_state": CKPT_SAVES,
+        "jit.host.layer_state": 0,
+        "jit.host.optimizer_state": 0,
+    }
+    violations.update({f"ckpt:{k}": (csteady.get(k, 0), want)
+                       for k, want in cinvariants.items()
+                       if csteady.get(k, 0) != want})
+
+    # ---- resilience gate 2: restores == injected preemptions ------------
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    FAULT_STEPS = 6
+    FAULT_SCHEDULE = "preempt@3"
+    INJECTED_PREEMPTIONS = 1
+    paddle.seed(0)
+    rmodel = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+    ropt = paddle.optimizer.AdamW(1e-3, parameters=rmodel.parameters())
+    rstep = pjit.CompiledTrainStep(rmodel, loss_fn, ropt)
+    rx = np.random.RandomState(1)
+    ds = TensorDataset(
+        [paddle.to_tensor(rx.randn(FAULT_STEPS * 4, 16).astype("float32")),
+         paddle.to_tensor(rx.randn(FAULT_STEPS * 4, 4).astype("float32"))])
+
+    def loader_factory(epoch):
+        return DataLoader(ds, batch_size=4, shuffle=False)
+
+    rbefore = counters.snapshot()
+    with tempfile.TemporaryDirectory() as ckdir:
+        with faultinject.fault_schedule(FAULT_SCHEDULE):
+            trainer = FaultTolerantTrainer(
+                rstep, loader_factory, CheckpointManager(ckdir, keep_last=2),
+                epochs=1, max_steps=FAULT_STEPS, save_every=3)
+            rlosses = trainer.run()
+    rsteady = counters.delta(rbefore)
+
+    rinvariants = {
+        "resilience.restores": INJECTED_PREEMPTIONS,
+        "resilience.recoveries": INJECTED_PREEMPTIONS,
+        "resilience.faults_injected.preempt": INJECTED_PREEMPTIONS,
+        "resilience.corrupt_detected": 0,
+        "resilience.save_failures": 0,
+    }
+    violations.update({f"fault:{k}": (rsteady.get(k, 0), want)
+                       for k, want in rinvariants.items()
+                       if rsteady.get(k, 0) != want})
+    if len(rlosses) != FAULT_STEPS or not all(
+            np.isfinite(v) for v in rlosses.values()):
+        violations["fault:trainer_losses"] = (len(rlosses), FAULT_STEPS)
+
     result = {"metric": "steady_state_counter_violations",
               "value": len(violations),
               "unit": f"violations/{MEASURE} steps "
@@ -165,7 +256,11 @@ def run():
               "steady_delta": steady,
               "fused_steady_delta": fsteady,
               "serving_steady_delta": ssteady,
-              "serving_prefill_programs": eng.stats()["prefill_programs"]}
+              "serving_prefill_programs": eng.stats()["prefill_programs"],
+              "ckpt_steady_delta": {k: v for k, v in csteady.items()
+                                    if k.startswith(("jit.", "resilience."))},
+              "fault_delta": {k: v for k, v in rsteady.items()
+                              if k.startswith("resilience.")}}
     print(json.dumps(result))
     if violations:
         raise AssertionError(

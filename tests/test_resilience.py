@@ -1,0 +1,663 @@
+"""Resilience: complete-state checkpointing + fault-tolerant training.
+
+The three contracts from the PR-5 tentpole:
+
+  * atomicity — a writer killed at ANY point before the commit rename
+    leaves only an ignored ``.tmp`` staging dir; the previous checkpoint
+    stays loadable (crash-mid-save test via ``faultinject.ckpt_crash``);
+  * integrity — per-chunk crc32 checksums are verified on load; corrupt
+    bytes raise ``CheckpointCorrupt`` naming the offending chunk, and the
+    manager falls back to the next older committed checkpoint;
+  * bit-identical resume — train 10 steps straight vs. 4 + preemption +
+    restore + 6 gives IDENTICAL losses, parameters, RNG chain and LR
+    (the checkpoint captures params/opt/scaler/scheduler/RNG/iterator
+    cursor completely; the replayed batches are bit-identical).
+
+Plus the satellites: ``wait_async_save`` concurrency + surface-ALL-errors
+semantics, transient-write retry with backoff, keep-last-N GC, and the
+prefetcher resume cursor (``consumed`` / ``start_offset`` skip-replay).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as pjit
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import checkpoint as dckpt
+from paddle_tpu.io import DataLoader, DevicePrefetcher, StackingPrefetcher, \
+    TensorDataset
+from paddle_tpu.optimizer import lr as lrsched
+from paddle_tpu.profiler import counters
+from paddle_tpu.resilience import (CheckpointCorrupt, CheckpointManager,
+                                   CheckpointWriteError, FaultTolerantTrainer,
+                                   faultinject)
+from paddle_tpu.tensor.random import default_generator
+
+
+def _mse(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+def _build(seed=7, fused_steps=1, use_sched=False):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(6, 12), nn.GELU(), nn.Linear(12, 3))
+    sched = lrsched.StepDecay(learning_rate=5e-2, step_size=3,
+                              gamma=0.5) if use_sched else None
+    opt = paddle.optimizer.AdamW(sched if sched is not None else 5e-2,
+                                 parameters=net.parameters())
+    step = pjit.CompiledTrainStep(net, _mse, opt, fused_steps=fused_steps)
+    return net, opt, step, sched
+
+
+def _dataset(n_batches, batch=4, seed=3):
+    rng = np.random.RandomState(seed)
+    return TensorDataset(
+        [paddle.to_tensor(rng.randn(n_batches * batch, 6).astype("float32")),
+         paddle.to_tensor(rng.randn(n_batches * batch, 3).astype("float32"))])
+
+
+def _factory(ds, batch=4):
+    def loader_factory(epoch):
+        return DataLoader(ds, batch_size=batch, shuffle=False)
+    return loader_factory
+
+
+def _params(net):
+    net_sd = net.state_dict()
+    return {k: np.array(np.asarray(v.numpy()), copy=True)
+            for k, v in net_sd.items()}
+
+
+def _run_steps(step, ds, n, batch=4):
+    losses = []
+    for i, item in enumerate(DataLoader(ds, batch_size=batch, shuffle=False)):
+        if i >= n:
+            break
+        losses.append(float(step(*item).numpy()))
+    return losses
+
+
+class TestCheckpointManagerRoundtrip:
+    def test_roundtrip_restores_exact_state(self, tmp_path):
+        net, opt, step, _ = _build()
+        ds = _dataset(8)
+        _run_steps(step, ds, 3)
+        mgr = CheckpointManager(tmp_path, keep_last=3)
+        mgr.save(step, 3, cursor={"epoch": 0, "offset": 3})
+        saved_params = _params(net)
+        saved_rng = np.asarray(default_generator().get_state())
+        _run_steps(step, ds, 2)  # diverge past the save point
+        for k, v in _params(net).items():
+            assert not np.array_equal(v, saved_params[k]), k
+
+        info = mgr.restore(step)
+        assert info["step"] == 3
+        assert info["cursor"] == {"epoch": 0, "offset": 3}
+        for k, v in _params(net).items():
+            np.testing.assert_array_equal(v, saved_params[k], err_msg=k)
+        np.testing.assert_array_equal(
+            np.asarray(default_generator().get_state()), saved_rng)
+
+    def test_restore_returns_none_when_empty(self, tmp_path):
+        _, _, step, _ = _build()
+        assert CheckpointManager(tmp_path).restore(step) is None
+        assert CheckpointManager(tmp_path).latest() is None
+
+    def test_restored_continuation_matches_uninterrupted(self, tmp_path):
+        ds = _dataset(8)
+        _, _, ref_step, _ = _build(seed=11)
+        ref = _run_steps(ref_step, ds, 5)
+
+        net, opt, step, _ = _build(seed=11)
+        got = _run_steps(step, ds, 3)
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(step, 3)
+        _run_steps(step, ds, 1)  # wander off; restore must undo this
+        mgr.restore(step)
+        for i, item in enumerate(DataLoader(ds, batch_size=4, shuffle=False)):
+            if i < 3:
+                continue
+            if i >= 5:
+                break
+            got.append(float(step(*item).numpy()))
+        assert got == ref
+
+    def test_keep_last_gc(self, tmp_path):
+        _, _, step, _ = _build()
+        ds = _dataset(2)
+        _run_steps(step, ds, 1)
+        mgr = CheckpointManager(tmp_path, keep_last=2)
+        before = counters.snapshot()
+        for s in range(1, 6):
+            mgr.save(step, s)
+        kept = sorted(d for d in os.listdir(tmp_path)
+                      if d.startswith("step-"))
+        assert kept == ["step-00000004", "step-00000005"]
+        assert mgr.latest() == 5
+        assert counters.delta(before).get("resilience.gc_removed", 0) == 3
+
+    def test_async_save_overlaps_and_restores(self, tmp_path):
+        net, opt, step, _ = _build()
+        ds = _dataset(8)
+        _run_steps(step, ds, 2)
+        mgr = CheckpointManager(tmp_path, async_save=True)
+        mgr.save(step, 2)            # write happens on a daemon thread
+        saved = _params(net)
+        _run_steps(step, ds, 2)      # overlap: training continues
+        mgr.wait()
+        mgr.restore(step)
+        for k, v in _params(net).items():
+            np.testing.assert_array_equal(v, saved[k], err_msg=k)
+
+    def test_save_costs_exactly_one_sync(self, tmp_path):
+        _, _, step, _ = _build()
+        ds = _dataset(4)
+        _run_steps(step, ds, 3)  # warm: hydrate + trace done
+        mgr = CheckpointManager(tmp_path)
+        before = counters.snapshot()
+        mgr.save(step, 3)
+        d = counters.delta(before)
+        assert d.get("jit.syncs", 0) == 1
+        assert d.get("jit.host.bind_layer_state", 0) == 1
+        assert d.get("jit.host.bind_optimizer_state", 0) == 1
+        assert d.get("jit.host.layer_state", 0) == 0
+        assert d.get("jit.host.optimizer_state", 0) == 0
+        assert d.get("jit.hydrates", 0) == 0
+        assert d.get("jit.traces", 0) == 0
+
+
+class TestAtomicity:
+    def test_crash_mid_save_leaves_previous_loadable(self, tmp_path):
+        net, opt, step, _ = _build()
+        ds = _dataset(8)
+        _run_steps(step, ds, 2)
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(step, 2)  # ordinal 0: clean
+        saved = _params(net)
+        _run_steps(step, ds, 2)
+        # ordinal 1 dies between chunk write and manifest/commit
+        with faultinject.fault_schedule("ckpt_crash@1"):
+            with pytest.raises(faultinject.SimulatedCrash):
+                mgr.save(step, 4)
+            assert faultinject.fired == [("ckpt_crash", 1)]
+        names = os.listdir(tmp_path)
+        assert "step-00000004" not in names           # never committed
+        assert any(n.startswith(".tmp-") for n in names)  # crashed staging
+        assert mgr.latest() == 2
+        info = mgr.restore(step)
+        assert info["step"] == 2
+        for k, v in _params(net).items():
+            np.testing.assert_array_equal(v, saved[k], err_msg=k)
+
+    def test_crash_is_not_swallowed_by_retry(self, tmp_path):
+        """SimulatedCrash is a BaseException: the CheckpointManager retry
+        loop (``except OSError``) and the trainer's recovery (``except
+        recoverable``) must both let it unwind, like a real kill."""
+        assert not issubclass(faultinject.SimulatedCrash, Exception)
+        _, _, step, _ = _build()
+        ds = _dataset(2)
+        _run_steps(step, ds, 1)
+        mgr = CheckpointManager(tmp_path, retries=5)
+        with faultinject.fault_schedule("ckpt_crash@0*5"):
+            with pytest.raises(faultinject.SimulatedCrash):
+                mgr.save(step, 1)
+            assert faultinject.fired == [("ckpt_crash", 0)]  # no retry
+
+    def test_next_successful_save_cleans_stale_tmp(self, tmp_path):
+        _, _, step, _ = _build()
+        ds = _dataset(4)
+        _run_steps(step, ds, 2)
+        mgr = CheckpointManager(tmp_path)
+        with faultinject.fault_schedule("ckpt_crash@0"):
+            with pytest.raises(faultinject.SimulatedCrash):
+                mgr.save(step, 2)
+        assert any(n.startswith(".tmp-") for n in os.listdir(tmp_path))
+        mgr.save(step, 3)
+        names = os.listdir(tmp_path)
+        assert not any(n.startswith(".tmp-") for n in names)
+        assert mgr.latest() == 3
+
+
+class TestChecksum:
+    @staticmethod
+    def _corrupt_one_chunk(step_dir, key_prefix="model/"):
+        """Rewrite one chunk array inside the npz with flipped bytes: the
+        file stays a valid archive, the payload is silently wrong — the
+        shape of real disk corruption crc32 exists to catch."""
+        fname = next(n for n in os.listdir(step_dir)
+                     if n.endswith(".distcp.npz"))
+        fpath = os.path.join(step_dir, fname)
+        with np.load(fpath) as z:
+            arrays = {k: np.array(z[k]) for k in z.files}
+        victim = next(k for k in arrays if k.startswith(key_prefix))
+        raw = arrays[victim].view(np.uint8).copy()
+        raw[0] ^= 0xFF
+        arrays[victim] = raw.view(arrays[victim].dtype).reshape(
+            arrays[victim].shape)
+        with open(fpath, "wb") as f:
+            np.savez(f, **arrays)
+        return victim, fpath
+
+    def test_corrupt_chunk_raises_naming_it(self, tmp_path):
+        _, _, step, _ = _build()
+        ds = _dataset(4)
+        _run_steps(step, ds, 2)
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(step, 2)
+        victim, fpath = self._corrupt_one_chunk(str(mgr._dir(2)))
+        before = counters.snapshot()
+        with pytest.raises(CheckpointCorrupt) as ei:
+            mgr.restore(step)  # only save is corrupt -> nothing loadable
+        msg = str(ei.value)
+        assert "checksum mismatch" in str(ei.value.__cause__ or ei.value) \
+            or "checksum mismatch" in msg
+        # the offending chunk is named somewhere in the chain
+        chain = msg + str(ei.value.__cause__ or "")
+        assert victim in chain
+        assert counters.delta(before).get(
+            "resilience.corrupt_detected", 0) >= 1
+
+    def test_corruption_falls_back_to_older_checkpoint(self, tmp_path):
+        net, opt, step, _ = _build()
+        ds = _dataset(8)
+        _run_steps(step, ds, 2)
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(step, 2)
+        older = _params(net)
+        _run_steps(step, ds, 2)
+        mgr.save(step, 4)
+        self._corrupt_one_chunk(str(mgr._dir(4)))
+        before = counters.snapshot()
+        info = mgr.restore(step)
+        assert info["step"] == 2
+        for k, v in _params(net).items():
+            np.testing.assert_array_equal(v, older[k], err_msg=k)
+        d = counters.delta(before)
+        assert d.get("resilience.corrupt_detected", 0) >= 1
+        assert d.get("resilience.restores", 0) == 1
+
+    def test_truncated_manifest_falls_back(self, tmp_path):
+        _, _, step, _ = _build()
+        ds = _dataset(8)
+        _run_steps(step, ds, 2)
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(step, 2)
+        _run_steps(step, ds, 2)
+        mgr.save(step, 4)
+        with open(os.path.join(mgr._dir(4), "MANIFEST.json"), "w") as f:
+            f.write('{"format": 1, "step":')  # torn write
+        with pytest.raises(json.JSONDecodeError):
+            json.load(open(os.path.join(mgr._dir(4), "MANIFEST.json")))
+        info = mgr.restore(step)
+        assert info["step"] == 2
+
+
+class TestWriteRetry:
+    def test_transient_write_error_retried(self, tmp_path):
+        _, _, step, _ = _build()
+        ds = _dataset(4)
+        _run_steps(step, ds, 2)
+        mgr = CheckpointManager(tmp_path, retries=3, backoff_s=0.001)
+        before = counters.snapshot()
+        with faultinject.fault_schedule("ckpt_write@0*2"):
+            mgr.save(step, 2)  # attempts 1-2 fail, attempt 3 lands
+            assert faultinject.fired == [("ckpt_write", 0)] * 2
+        d = counters.delta(before)
+        assert d.get("resilience.retries", 0) == 2
+        assert d.get("resilience.saves", 0) == 1
+        assert d.get("resilience.save_failures", 0) == 0
+        assert mgr.latest() == 2
+        assert mgr.restore(step)["step"] == 2
+
+    def test_retries_exhausted_raises_write_error(self, tmp_path):
+        _, _, step, _ = _build()
+        ds = _dataset(4)
+        _run_steps(step, ds, 2)
+        mgr = CheckpointManager(tmp_path, retries=2, backoff_s=0.001)
+        before = counters.snapshot()
+        with faultinject.fault_schedule("ckpt_write@0*5"):
+            with pytest.raises(CheckpointWriteError):
+                mgr.save(step, 2)
+        d = counters.delta(before)
+        assert d.get("resilience.save_failures", 0) == 1
+        assert d.get("resilience.retries", 0) == 2
+        assert d.get("resilience.saves", 0) == 0
+        assert mgr.latest() is None
+
+    def test_injected_write_error_is_an_ioerror(self):
+        assert issubclass(faultinject.InjectedWriteError, IOError)
+        assert issubclass(faultinject.InjectedWriteError,
+                          faultinject.InjectedFault)
+
+
+class TestWaitAsyncSave:
+    def test_async_failure_surfaced_with_cause(self, tmp_path, monkeypatch):
+        boom = OSError("disk gone")
+
+        def bad_savez(f, **kw):
+            raise boom
+        monkeypatch.setattr(dckpt.np, "savez", bad_savez)
+        dckpt.save_state_dict(
+            {"w": paddle.to_tensor(np.ones((2, 2), np.float32))},
+            str(tmp_path), async_save=True)
+        with pytest.raises(RuntimeError, match="async checkpoint save"):
+            dckpt.wait_async_save()
+        # errors were drained: a second wait is clean
+        dckpt.wait_async_save()
+
+    def test_all_errors_surfaced_not_just_first(self):
+        with dckpt._ASYNC_LOCK:
+            dckpt._ASYNC_ERRORS.extend(
+                [OSError("first failure"), OSError("second failure")])
+        with pytest.raises(RuntimeError) as ei:
+            dckpt.wait_async_save()
+        msg = str(ei.value)
+        assert "2 async checkpoint saves failed" in msg
+        assert "first failure" in msg and "second failure" in msg
+        assert isinstance(ei.value.__cause__, OSError)
+        dckpt.wait_async_save()  # drained
+
+    def test_concurrent_waiters_all_complete(self):
+        release = threading.Event()
+        writer = threading.Thread(target=release.wait, daemon=True)
+        with dckpt._ASYNC_LOCK:
+            dckpt._ASYNC_THREADS.append(writer)
+        writer.start()
+        results = []
+
+        def waiter():
+            try:
+                dckpt.wait_async_save()
+                results.append("ok")
+            except BaseException as e:  # pragma: no cover - fail loudly
+                results.append(e)
+        waiters = [threading.Thread(target=waiter) for _ in range(4)]
+        for t in waiters:
+            t.start()
+        time.sleep(0.05)      # all four are blocked joining the writer
+        release.set()
+        for t in waiters:
+            t.join(timeout=5)
+        assert results == ["ok"] * 4
+        assert not dckpt._ASYNC_THREADS
+
+    def test_save_is_readable_after_wait(self, tmp_path):
+        w = np.arange(6, dtype=np.float32).reshape(2, 3)
+        dckpt.save_state_dict({"w": paddle.to_tensor(w)}, str(tmp_path),
+                              async_save=True)
+        dckpt.wait_async_save()
+        tgt = {"w": paddle.to_tensor(np.zeros((2, 3), np.float32))}
+        dckpt.load_state_dict(tgt, str(tmp_path))
+        np.testing.assert_array_equal(np.asarray(tgt["w"].numpy()), w)
+
+
+class TestPrefetcherCursor:
+    def test_device_prefetcher_skip_replay(self):
+        ds = _dataset(6)
+        loader = DataLoader(ds, batch_size=4, shuffle=False)
+        full_before = counters.snapshot()
+        full = [tuple(np.asarray(t.numpy()) for t in b)
+                for b in DevicePrefetcher(loader, depth=2)]
+        full_puts = counters.delta(full_before).get("io.device_put_calls", 0)
+        assert len(full) == 6
+
+        before = counters.snapshot()
+        pref = DevicePrefetcher(DataLoader(ds, batch_size=4, shuffle=False),
+                                depth=2, start_offset=2)
+        assert len(pref) == 4
+        got = [tuple(np.asarray(t.numpy()) for t in b) for b in pref]
+        d = counters.delta(before)
+        assert pref.consumed == 6
+        assert d.get("io.skipped_batches", 0) == 2
+        # skipped batches never hit the device: 4/6 of the full run's puts
+        assert d.get("io.device_put_calls", 0) == full_puts * 4 // 6
+        assert len(got) == 4
+        for g, f in zip(got, full[2:]):
+            for a, b in zip(g, f):
+                np.testing.assert_array_equal(a, b)
+
+    def test_stacking_prefetcher_resume_alignment(self):
+        ds = _dataset(8)
+        full = list(StackingPrefetcher(
+            DataLoader(ds, batch_size=4, shuffle=False), 2))
+        assert len(full) == 4
+        pref = StackingPrefetcher(DataLoader(ds, batch_size=4, shuffle=False),
+                                  2, start_offset=4)
+        got = list(pref)
+        assert len(got) == 2
+        assert pref.consumed == 8
+        for gwin, fwin in zip(got, full[2:]):
+            assert gwin.k == fwin.k == 2
+            for a, b in zip(gwin, fwin):
+                np.testing.assert_array_equal(np.asarray(a.numpy()),
+                                              np.asarray(b.numpy()))
+
+
+class _Baseline:
+    """Uninterrupted trainer run: the bit-identity reference."""
+
+    def __init__(self, tmp_path, steps=10, save_every=4, fused_steps=1,
+                 use_sched=False, n_batches=12, seed=7):
+        net, opt, step, sched = _build(seed=seed, fused_steps=fused_steps,
+                                       use_sched=use_sched)
+        ds = _dataset(n_batches)
+        trainer = FaultTolerantTrainer(
+            step, _factory(ds), CheckpointManager(tmp_path, keep_last=2),
+            scheduler=sched, epochs=2, max_steps=steps,
+            save_every=save_every)
+        self.losses = trainer.run()
+        self.params = _params(net)
+        self.rng = np.asarray(default_generator().get_state())
+        self.lr = opt.get_lr()
+        self.ds, self.seed = ds, seed
+        self.fused_steps, self.use_sched = fused_steps, use_sched
+        self.steps, self.save_every = steps, save_every
+
+    def faulted_run(self, tmp_path, schedule, expect_recoveries=1,
+                    **trainer_kw):
+        net, opt, step, sched = _build(seed=self.seed,
+                                       fused_steps=self.fused_steps,
+                                       use_sched=self.use_sched)
+        before = counters.snapshot()
+        with faultinject.fault_schedule(schedule):
+            trainer = FaultTolerantTrainer(
+                step, _factory(self.ds),
+                CheckpointManager(tmp_path, keep_last=2),
+                scheduler=sched, epochs=2, max_steps=self.steps,
+                save_every=self.save_every, **trainer_kw)
+            losses = trainer.run()
+        assert trainer.recoveries == expect_recoveries
+        d = counters.delta(before)
+        assert d.get("resilience.recoveries", 0) == expect_recoveries
+        assert d.get("resilience.restores", 0) == expect_recoveries
+        return net, opt, losses, d
+
+
+class TestBitIdenticalResume:
+    def test_preempt_resume_bit_identity(self, tmp_path):
+        """THE flagship: 10 straight steps vs 4 + preempt + restore + 6 —
+        identical losses, params, RNG chain, LR."""
+        base = _Baseline(tmp_path / "base", use_sched=True)
+        net, opt, losses, d = base.faulted_run(tmp_path / "faulted",
+                                               "preempt@4")
+        assert d.get("resilience.recovered.SimulatedPreemption", 0) == 1
+        assert losses == base.losses          # all 10, bit-equal floats
+        for k, v in _params(net).items():
+            np.testing.assert_array_equal(v, base.params[k], err_msg=k)
+        np.testing.assert_array_equal(
+            np.asarray(default_generator().get_state()), base.rng)
+        assert opt.get_lr() == base.lr
+
+    def test_preempt_resume_bit_identity_fused(self, tmp_path):
+        """Same contract through the fused-window (StackingPrefetcher /
+        scan-dispatch) path: preemption between windows."""
+        base = _Baseline(tmp_path / "base", steps=8, fused_steps=2)
+        net, _, losses, _ = base.faulted_run(tmp_path / "faulted",
+                                             "preempt@4")
+        assert losses == base.losses
+        for k, v in _params(net).items():
+            np.testing.assert_array_equal(v, base.params[k], err_msg=k)
+
+    def test_preempt_mid_save_interval(self, tmp_path):
+        """Preemption at step 6 restores the step-4 checkpoint and replays
+        5-6; the replayed entries overwrite bit-identically."""
+        base = _Baseline(tmp_path / "base")
+        _, _, losses, d = base.faulted_run(tmp_path / "faulted", "preempt@6")
+        assert losses == base.losses
+        assert d.get("io.skipped_batches", 0) == 4  # replay from offset 4
+
+    def test_loader_fault_recovery(self, tmp_path):
+        base = _Baseline(tmp_path / "base")
+        _, _, losses, d = base.faulted_run(tmp_path / "faulted", "loader@6")
+        assert d.get("resilience.recovered.InjectedLoaderError", 0) == 1
+        assert losses == base.losses
+
+    def test_nan_loss_recovery(self, tmp_path):
+        """A poisoned batch NaNs the loss; the trainer restores the last
+        good checkpoint and the replay (schedule consumed) is clean — the
+        final trajectory matches the baseline bit-for-bit."""
+        base = _Baseline(tmp_path / "base")
+        net, _, losses, d = base.faulted_run(tmp_path / "faulted",
+                                             "nan_loss@5")
+        assert d.get("resilience.recovered.NonFiniteLossError", 0) == 1
+        assert all(np.isfinite(v) for v in losses.values())
+        assert losses == base.losses
+        for k, v in _params(net).items():
+            np.testing.assert_array_equal(v, base.params[k], err_msg=k)
+
+    def test_multiple_faults_one_run(self, tmp_path):
+        base = _Baseline(tmp_path / "base")
+        _, _, losses, d = base.faulted_run(
+            tmp_path / "faulted", "preempt@3;nan_loss@7",
+            expect_recoveries=2)
+        assert losses == base.losses
+        assert d.get("resilience.faults_injected", 0) == 2
+
+    def test_restart_from_disk_resumes(self, tmp_path):
+        """Process-death shape: a NEW trainer (fresh model, different init
+        seed) over the same checkpoint dir resumes from the last save and
+        converges to the uninterrupted trajectory."""
+        base = _Baseline(tmp_path / "base", steps=8)
+        ck = tmp_path / "faulted"
+        net1, _, step1, _ = _build(seed=7)
+        t1 = FaultTolerantTrainer(step1, _factory(base.ds),
+                                  CheckpointManager(ck, keep_last=2),
+                                  epochs=2, max_steps=4, save_every=4)
+        first = t1.run()
+        assert sorted(first) == [1, 2, 3, 4]
+        # "restart": different init seed — restore overwrites everything
+        net2, _, step2, _ = _build(seed=99)
+        t2 = FaultTolerantTrainer(step2, _factory(base.ds),
+                                  CheckpointManager(ck, keep_last=2),
+                                  epochs=2, max_steps=8, save_every=4)
+        second = t2.run()
+        assert sorted(second) == [5, 6, 7, 8]  # no replay of committed work
+        for s in (5, 6, 7, 8):
+            assert second[s] == base.losses[s]
+        for k, v in _params(net2).items():
+            np.testing.assert_array_equal(v, base.params[k], err_msg=k)
+
+    def test_max_recoveries_exhausted_reraises(self, tmp_path):
+        _, _, step, _ = _build()
+        ds = _dataset(6)
+        with faultinject.fault_schedule("preempt@2*10"):
+            trainer = FaultTolerantTrainer(
+                step, _factory(ds), CheckpointManager(tmp_path),
+                epochs=1, max_steps=6, save_every=100, max_recoveries=2)
+            with pytest.raises(faultinject.SimulatedPreemption):
+                trainer.run()
+        assert trainer.recoveries == 3  # 2 recovered + the fatal third
+
+
+class TestScalerState:
+    def test_grad_scaler_state_rides_the_checkpoint(self, tmp_path):
+        from paddle_tpu.amp import GradScaler
+
+        def build():
+            paddle.seed(13)
+            net = nn.Linear(6, 3)
+            opt = paddle.optimizer.AdamW(5e-2, parameters=net.parameters())
+            scaler = GradScaler(init_loss_scaling=1024.0,
+                                incr_every_n_steps=2)
+            return net, pjit.CompiledTrainStep(net, _mse, opt, scaler=scaler)
+
+        ds = _dataset(8)
+        net, step = build()
+        _run_steps(step, ds, 3)  # dynamic loss scale moves (incr_every=2)
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(step, 3)
+        saved = step.scaler.state_dict()
+        assert saved["scale"] != 1024.0  # the trajectory actually moved
+        _run_steps(step, ds, 2)
+        assert step.scaler.state_dict() != saved
+        mgr.restore(step)
+        assert step.scaler.state_dict() == saved
+
+
+class TestSchedulerState:
+    def test_reduce_on_plateau_roundtrip(self):
+        a = lrsched.ReduceOnPlateau(learning_rate=0.1, factor=0.5,
+                                    patience=1, cooldown=1)
+        for m in (1.0, 1.0, 1.0, 0.2, 0.5):
+            a.step(m)
+        sd = a.state_dict()
+        for k in ("best", "num_bad", "cooldown_counter", "last_lr"):
+            assert k in sd
+        b = lrsched.ReduceOnPlateau(learning_rate=0.1, factor=0.5,
+                                    patience=1, cooldown=1)
+        b.set_state_dict(sd)
+        assert b.last_lr == a.last_lr
+        assert b.best == a.best
+        assert b.num_bad == a.num_bad
+        assert b.cooldown_counter == a.cooldown_counter
+        # identical subsequent trajectory
+        for m in (0.9, 0.9, 0.9):
+            a.step(m)
+            b.step(m)
+            assert a.last_lr == b.last_lr
+
+
+class TestFaultInject:
+    def test_spec_parsing(self):
+        sched = faultinject._parse("ckpt_write@1*2; preempt@4, nan_loss@7")
+        assert sched == {("ckpt_write", 1): 2, ("preempt", 4): 1,
+                         ("nan_loss", 7): 1}
+        with pytest.raises(ValueError, match="bad fault schedule"):
+            faultinject._parse("preempt4")
+
+    def test_take_consumes_and_counts(self):
+        before = counters.snapshot()
+        with faultinject.fault_schedule("nan_loss@3*2"):
+            assert not faultinject.take("nan_loss", 2)
+            assert faultinject.take("nan_loss", 3)
+            assert faultinject.take("nan_loss", 3)
+            assert not faultinject.take("nan_loss", 3)  # exhausted
+            assert faultinject.fired == [("nan_loss", 3)] * 2
+        d = counters.delta(before)
+        assert d.get("resilience.faults_injected", 0) == 2
+        assert d.get("resilience.faults_injected.nan_loss", 0) == 2
+        assert not faultinject.active()
+
+    def test_maybe_fault_raises_site_exception(self):
+        with faultinject.fault_schedule("loader@5"):
+            faultinject.maybe_fault("loader", 4)  # not scheduled: no-op
+            with pytest.raises(faultinject.InjectedLoaderError):
+                faultinject.maybe_fault("loader", 5)
+            faultinject.maybe_fault("loader", 5)  # consumed: no-op
+
+    def test_flag_driven_schedule(self):
+        from paddle_tpu.core import flags as cflags
+        try:
+            cflags.set_flags({"FLAGS_fault_schedule": "preempt@9"})
+            assert faultinject.active()
+            with pytest.raises(faultinject.SimulatedPreemption):
+                faultinject.maybe_fault("preempt", 9)
+        finally:
+            cflags.set_flags({"FLAGS_fault_schedule": ""})
+        assert not faultinject.active()

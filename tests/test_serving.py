@@ -266,6 +266,60 @@ class TestRobustness:
         assert np.array_equal(streamed, ref)
         assert np.array_equal(h.output_ids(), list(p) + list(ref))
 
+    def test_queued_deadline_expiry_evicts_before_prefill(self):
+        """An expired-deadline request is dropped from the QUEUE — counted
+        under serving.deadline_expired, never reaching prefill — while a
+        healthy request admitted in the same step is unaffected."""
+        m = _model()
+        rng = np.random.default_rng(12)
+        p_live = rng.integers(0, 64, size=5).tolist()
+        ref = _ref_generate(m, p_live, 4)
+        eng = _engine(m)
+        before = counters.snapshot()
+        h_dead = eng.add_request(rng.integers(0, 64, size=4).tolist(),
+                                 max_new_tokens=8, deadline_s=0.0)
+        h_live = eng.add_request(p_live, max_new_tokens=4)
+        _run(eng, [h_dead, h_live])
+        d = counters.delta(before)
+        assert h_dead.finish_reason == "deadline"
+        assert h_dead.tokens == []
+        assert h_live.finish_reason == "length"
+        assert np.array_equal(h_live.tokens, ref)
+        assert d.get("serving.deadline_expired", 0) == 1
+        # only the live request ever prefilled (no slot/work for the dead)
+        assert d.get("serving.prefill_batches", 0) == 1
+        assert eng.stats()["free_slots"] == eng.max_slots
+
+    def test_poisoned_request_contained_to_error(self):
+        """A request whose prefill blows up finishes with
+        finish_reason="error" (exception on .error) — the slot is returned
+        and every OTHER request still matches sequential generate."""
+        from paddle_tpu.resilience import faultinject
+        m = _model()
+        rng = np.random.default_rng(13)
+        p_good = rng.integers(0, 64, size=6).tolist()
+        ref = _ref_generate(m, p_good, 4)
+        eng = _engine(m)
+        h_bad = eng.add_request(rng.integers(0, 64, size=4).tolist(),
+                                max_new_tokens=8)   # rid 0
+        h_good = eng.add_request(p_good, max_new_tokens=4)  # rid 1
+        before = counters.snapshot()
+        with faultinject.fault_schedule(f"serving_prefill@{h_bad.rid}"):
+            _run(eng, [h_bad, h_good])
+            assert faultinject.fired == [("serving_prefill", h_bad.rid)]
+        d = counters.delta(before)
+        assert h_bad.finish_reason == "error"
+        assert isinstance(h_bad.error, faultinject.InjectedFault)
+        assert h_bad.tokens == []
+        assert h_good.finish_reason == "length"
+        assert np.array_equal(h_good.tokens, ref)
+        assert d.get("serving.request_errors", 0) == 1
+        assert eng.stats()["free_slots"] == eng.max_slots
+        # the engine keeps serving after containment
+        h_next = eng.add_request(p_good, max_new_tokens=4)
+        _run(eng, [h_next])
+        assert np.array_equal(h_next.tokens, ref)
+
 
 class TestBuckets:
     def test_bucket_length(self):
