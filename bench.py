@@ -24,8 +24,14 @@ Legs (perf round 5):
   the same requests run sequentially through ``GPT.generate`` — reports
   decode tokens/s for both and ``serve_speedup``, and asserts the engine
   output is token-identical to the sequential path.
-Set PTPU_BENCH=125m|760m|serve to run a single leg.  PTPU_FUSED_STEPS
-sets the fused window length K (default 4; 1 disables the fused leg).
+- gpt125m_fleet (elastic-fleet leg): the same seeded request set through
+  a 2-replica ``serving.ServingFleet`` clean, then with one replica
+  killed mid-decode (``faultinject`` ``replica_crash``) — reports decode
+  tokens/s for both and ``churn_retention``, and gates the durability
+  invariants (zero lost requests, churn output token-identical to clean).
+Set PTPU_BENCH=125m|760m|serve|ckpt|fleet to run a single leg.
+PTPU_FUSED_STEPS sets the fused window length K (default 4; 1 disables
+the fused leg).
 """
 
 import json
@@ -246,6 +252,83 @@ def _run_serve_leg(cfg, n_requests=8, max_new=64, max_slots=8,
     return leg
 
 
+def _run_fleet_leg(cfg, replicas=2, n_requests=8, max_new=32, max_slots=4,
+                   min_bucket=8, seed=0):
+    """Elastic-fleet leg: the same seeded request set through a
+    multi-replica ``ServingFleet`` twice — clean, then with one replica
+    killed mid-decode (deterministic ``replica_crash`` on the first
+    request).  Reports aggregate decode tokens/s for both runs and the
+    churn retention fraction, and gates the durability invariants: zero
+    lost requests, respawns == injected kills, and the churn output
+    token-identical to the clean run (same seeds → same streams, replayed
+    across the respawn)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.profiler import counters
+    from paddle_tpu.resilience import faultinject
+    from paddle_tpu.serving import ServingFleet
+
+    paddle.seed(seed)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(seed)
+    S = cfg.max_seq_len
+    lens = [int(rng.randint(max(2, S // 16), S - max_new))
+            for _ in range(n_requests)]
+    prompts = [rng.randint(0, cfg.vocab_size, size=n).tolist()
+               for n in lens]
+    seeds = list(range(100, 100 + n_requests))
+
+    fleet = ServingFleet(model, replicas=replicas, max_slots=max_slots,
+                         max_seq_len=S, min_bucket=min_bucket,
+                         threaded=False, warm_buckets=lens)
+
+    def run_pass(kill=False):
+        before = counters.snapshot()
+        t0 = time.perf_counter()
+        hs = [fleet.submit(p, max_new_tokens=max_new, seed=s)
+              for p, s in zip(prompts, seeds)]
+        if kill:
+            with faultinject.fault_schedule(
+                    f"replica_crash@{hs[0].rid}"):
+                fleet.join(hs)
+        else:
+            fleet.join(hs)
+        dt = time.perf_counter() - t0
+        return hs, dt, counters.delta(before)
+
+    run_pass()  # warm timing pass (programs already compiled at spawn)
+    clean_hs, clean_s, clean_d = run_pass()
+    churn_hs, churn_s, churn_d = run_pass(kill=True)
+    fleet.drain()
+
+    match = all(c.finish_reason == "length" and k.finish_reason == "length"
+                and c.tokens == k.tokens
+                for c, k in zip(clean_hs, churn_hs))
+    decode_tokens = n_requests * max_new
+    clean_tps = decode_tokens / max(clean_s, 1e-9)
+    churn_tps = decode_tokens / max(churn_s, 1e-9)
+    leg = {"replicas": replicas,
+           "requests": n_requests,
+           "max_new_tokens": max_new,
+           "decode_tokens_per_sec": round(clean_tps, 2),
+           "churn_decode_tokens_per_sec": round(churn_tps, 2),
+           "churn_retention": round(churn_tps / max(clean_tps, 1e-9), 4),
+           "respawns": churn_d.get("serving.fleet.respawns", 0),
+           "retried": churn_d.get("serving.fleet.retried", 0),
+           "lost": churn_d.get("serving.fleet.lost", 0),
+           "replayed_tokens": churn_d.get("serving.fleet.replayed_tokens",
+                                          0),
+           "steady_retraces": clean_d.get("serving.retraces", 0),
+           "outputs_match_clean": match}
+    if (not match or leg["lost"] != 0 or leg["respawns"] != 1
+            or leg["retried"] < 1 or leg["steady_retraces"] != 0):
+        raise AssertionError(
+            f"fleet leg broke the durability invariants: {leg}")
+    del fleet, model
+    return leg
+
+
 def main():
     if os.environ.get("PTPU_BENCH_SMOKE") == "1":
         # perf-contract smoke leg: asserts steady-state steps do zero
@@ -291,13 +374,18 @@ def main():
         # budget (overhead number is informational on CPU)
         out["ckpt"] = _run_ckpt_leg(cfg, 2, 128, 4,
                                     fused_steps=max(1, fused_k))
+        # tiny fleet leg: durability gates (zero lost, respawn == kills,
+        # churn output identical) always; throughput informational on CPU
+        out["fleet"] = _run_fleet_leg(cfg, replicas=2, n_requests=4,
+                                      max_new=8, max_slots=2,
+                                      min_bucket=4)
         print(json.dumps(out))
         return
 
     which = os.environ.get("PTPU_BENCH", "all")
-    if which not in ("all", "760m", "125m", "serve", "ckpt"):
+    if which not in ("all", "760m", "125m", "serve", "ckpt", "fleet"):
         raise SystemExit(
-            f"PTPU_BENCH={which!r}: expected all|760m|125m|serve|ckpt")
+            f"PTPU_BENCH={which!r}: expected all|760m|125m|serve|ckpt|fleet")
     legs = {}
     if which in ("all", "760m"):
         cfg = GPTConfig.gpt3_760m(vocab_size=50304, max_seq_len=1024,
@@ -354,7 +442,28 @@ def main():
                                    recompute=None)
         legs["gpt125m_serve"] = _run_serve_leg(scfg, n_requests=8,
                                                max_new=64, max_slots=8)
+    if which in ("all", "fleet"):
+        # elastic-fleet leg: multi-replica throughput with and without
+        # one replica killed mid-decode (acceptance: zero lost requests,
+        # churn output token-identical to the clean run)
+        fcfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
+                                   dtype="bfloat16",
+                                   use_flash_attention=False,
+                                   recompute=None)
+        legs["gpt125m_fleet"] = _run_fleet_leg(fcfg, replicas=2,
+                                               n_requests=8, max_new=64,
+                                               max_slots=4)
 
+    if set(legs) == {"gpt125m_fleet"}:  # fleet-only run: durability line
+        leg = legs["gpt125m_fleet"]
+        print(json.dumps({
+            "metric": "gpt125m_fleet_decode_tokens_per_sec",
+            "value": leg["decode_tokens_per_sec"],
+            "unit": "tokens/s",
+            "vs_baseline": leg["churn_retention"],  # vs one replica killed
+            "legs": legs,
+        }))
+        return
     if set(legs) == {"gpt125m_ckpt"}:  # ckpt-only run: overhead line
         leg = legs["gpt125m_ckpt"]
         print(json.dumps({

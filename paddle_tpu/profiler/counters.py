@@ -29,6 +29,13 @@ Well-known names (see README "Observability" for the full table):
   serving.deadline_expired (queued past-deadline, evicted pre-prefill)
   serving.request_errors (poisoned requests contained to reason "error")
   serving.slot_occupancy / serving.prefill_programs (gauges)
+  serving.fleet.dispatched / serving.fleet.shed (SLO load shedding)
+  serving.fleet.retried (fault-driven requeues, at-most-once re-prefill)
+  serving.fleet.respawns / serving.fleet.replica_deaths[.<reason>]
+  serving.fleet.heartbeat_misses (stall detector trips)
+  serving.fleet.completed[.<reason>] / serving.fleet.replayed_tokens
+  serving.fleet.lost (admitted request without terminal state; MUST be 0)
+  serving.fleet.replicas / serving.fleet.decode_tps (gauges)
   resilience.saves / resilience.save_ms / resilience.restores
   resilience.retries / resilience.corrupt_detected
   resilience.recoveries / resilience.recovered.<ExcType>

@@ -39,6 +39,18 @@ Well-known sites
                      poisons the input rather than raising).
 ``serving_prefill``  per-request failure inside LLMEngine admission;
                      index = request id.
+``replica_crash``    SimulatedCrash of the serving-fleet replica that is
+                     decoding fleet request ``index`` — fires on the
+                     replica's next health-checked step once the request
+                     is active, so the same schedule kills the same
+                     point in the stream whatever replica holds it.
+``decode_stall``     freezes (hangs) the replica decoding fleet request
+                     ``index``: heartbeats stop, the fleet's stall
+                     detector must notice and respawn.  Queried via
+                     :func:`take` (the replica hangs rather than raises).
+``router_queue``     failure inside ServingFleet.submit's routing/enqueue
+                     path; index = fleet request id.  Surfaced to the
+                     caller as a structured ``RetryAfter`` shed.
 ===================  ====================================================
 
 Every fired fault is appended to :data:`fired` (``(site, index)`` tuples)
@@ -92,6 +104,9 @@ _EXC = {
     "preempt": SimulatedPreemption,
     "loader": InjectedLoaderError,
     "serving_prefill": InjectedFault,
+    "replica_crash": SimulatedCrash,
+    "decode_stall": InjectedFault,   # consumed via take(); never raised
+    "router_queue": InjectedFault,
 }
 
 _LOCK = threading.Lock()
@@ -207,7 +222,8 @@ _flags.define_flag(
     "FLAGS_fault_schedule", "",
     "Deterministic fault-injection schedule for resilience testing: "
     "'site@index[*count];...' with sites ckpt_write/ckpt_crash/preempt/"
-    "loader/nan_loss/serving_prefill (see paddle_tpu.resilience."
-    "faultinject).  Empty disables injection.")
+    "loader/nan_loss/serving_prefill/replica_crash/decode_stall/"
+    "router_queue (see paddle_tpu.resilience.faultinject).  Empty "
+    "disables injection.")
 _flags.register_flag_observer("FLAGS_fault_schedule",
                               lambda v: set_schedule(v or None))

@@ -2,14 +2,20 @@
 
 A slot-based serving engine (Orca-style iteration-level scheduling over a
 device-resident KV arena, vLLM-style admission specialised to TPU static
-shapes) plus the sampling helpers it shares with ``GPT.generate``.  See
-``serving.engine`` for the design notes and README "Serving" for the API
-tour.
+shapes) plus the sampling helpers it shares with ``GPT.generate``, and the
+elastic multi-replica layer on top: ``ServingFleet`` runs N engines behind
+an SLO-aware ``Router`` with heartbeat health-checking and fault-driven
+drain/respawn.  See ``serving.engine`` / ``serving.fleet`` for the design
+notes and README "Serving" / "Elastic serving" for the API tour.
 """
 
 from .engine import (EngineBackpressure, EngineClosed, LLMEngine,  # noqa: F401
                      Request, bucket_length)
+from .fleet import FleetRequest, Replica, ServingFleet  # noqa: F401
+from .router import RetryAfter, Router  # noqa: F401
 from .sampling import filter_logits, sample_tokens  # noqa: F401
 
 __all__ = ["LLMEngine", "Request", "EngineBackpressure", "EngineClosed",
-           "bucket_length", "filter_logits", "sample_tokens"]
+           "bucket_length", "filter_logits", "sample_tokens",
+           "ServingFleet", "FleetRequest", "Replica", "Router",
+           "RetryAfter"]

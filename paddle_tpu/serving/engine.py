@@ -53,7 +53,20 @@ warnings.filterwarnings("ignore",
 
 
 class EngineBackpressure(RuntimeError):
-    """add_request refused: the bounded request queue is full."""
+    """add_request refused: the bounded request queue is full (or, at the
+    fleet router, admission was shed).  Carries the structured retry info
+    clients need to back off intelligently:
+
+    * ``queue_depth`` — requests waiting at refusal time.
+    * ``retry_after_hint`` — estimated seconds until the backlog drains
+      (``outstanding_tokens / decode tokens/s EMA``), or None when the
+      engine has produced no throughput estimate yet.
+    """
+
+    def __init__(self, msg="", queue_depth=0, retry_after_hint=None):
+        super().__init__(msg)
+        self.queue_depth = int(queue_depth)
+        self.retry_after_hint = retry_after_hint
 
 
 class EngineClosed(RuntimeError):
@@ -67,7 +80,7 @@ class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "do_sample",
                  "temperature", "top_k", "top_p", "eos_token_id", "seed",
                  "state", "finish_reason", "tokens", "slot", "arrival_ns",
-                 "deadline", "_cancel", "_engine", "error")
+                 "deadline", "_cancel", "_engine", "error", "tag")
 
     def __init__(self, rid, prompt, max_new_tokens, do_sample, temperature,
                  top_k, top_p, eos_token_id, seed, deadline, engine):
@@ -90,6 +103,7 @@ class Request:
         self.deadline = deadline  # absolute time.monotonic() or None
         self._cancel = False
         self._engine = engine
+        self.tag = None           # opaque owner backref (fleet router)
 
     @property
     def is_finished(self):
@@ -97,7 +111,10 @@ class Request:
 
     def cancel(self):
         """Request cancellation; the engine evicts the request (or drops
-        it from the queue) on its next step."""
+        it from the queue) on its next step.  Safe to call from any
+        thread, any number of times, including after the request finished
+        (the finish CAS in ``LLMEngine._finish`` makes the late cancel a
+        no-op — it can never double-release the slot)."""
         self._cancel = True
 
     def output_ids(self):
@@ -179,9 +196,15 @@ class LLMEngine:
         self._slots: list = [None] * B
         self._free = list(range(B - 1, -1, -1))  # slot 0 handed out first
         self._queue: deque = deque()
-        self._cond = threading.Condition()
+        # ONE engine lock: the Condition's (re-entrant) lock guards the
+        # queue, slot bookkeeping, the finish CAS, and the stats()
+        # aggregates below — stats() is a single-acquisition snapshot
+        self._cond = threading.Condition(threading.RLock())
         self._closed = False
         self._rid = itertools.count()
+        self._outstanding = 0     # undelivered tokens across queued+active
+        self._tps_ema = 0.0       # decode tokens/s, EMA over launches
+        self._ema_alpha = 0.25
 
         self._prefill_jits = {}   # bucket -> jitted prefill
         self._insert_jits = {}    # bucket -> jitted insert
@@ -294,32 +317,54 @@ class LLMEngine:
             while len(self._queue) >= self.queue_size:
                 if not block:
                     raise EngineBackpressure(
-                        f"request queue full ({self.queue_size})")
+                        f"request queue full ({self.queue_size})",
+                        queue_depth=len(self._queue),
+                        retry_after_hint=self._retry_hint_locked())
                 if not self._cond.wait(timeout):
                     raise EngineBackpressure(
                         f"request queue full ({self.queue_size}); timed "
-                        f"out after {timeout}s")
+                        f"out after {timeout}s",
+                        queue_depth=len(self._queue),
+                        retry_after_hint=self._retry_hint_locked())
                 if self._closed:
                     raise EngineClosed("engine drained while waiting")
             self._queue.append(req)
+            self._outstanding += req.max_new_tokens
         counters.inc("serving.requests")
         return req
 
+    def _retry_hint_locked(self):
+        """Seconds until the current backlog drains at the EMA decode
+        rate; None before the first decode launch.  Caller holds _cond."""
+        if self._tps_ema <= 0:
+            return None
+        return self._outstanding / self._tps_ema
+
     # -- scheduling ----------------------------------------------------------
     def _finish(self, req, reason, events):
-        req.state = "finished"
-        req.finish_reason = reason
-        if req.slot is not None:
-            s = req.slot
-            self._slots[s] = None
-            self._free.append(s)
-            self._dosample[s] = False
-            self._tok[s] = 0
-            self._pos[s] = 0
-            req.slot = None
+        """Terminal transition.  Thread-safe compare-and-set on the
+        request state under the engine lock: the fleet router cancels /
+        reaps from a different thread than the replica's step() loop, and
+        a double finish must not fire twice or double-release the slot."""
+        with self._cond:
+            if req.state == "finished":
+                return False
+            req.state = "finished"
+            req.finish_reason = reason
+            self._outstanding -= max(
+                0, req.max_new_tokens - len(req.tokens))
+            if req.slot is not None:
+                s = req.slot
+                self._slots[s] = None
+                self._free.append(s)
+                self._dosample[s] = False
+                self._tok[s] = 0
+                self._pos[s] = 0
+                req.slot = None
         counters.inc("serving.evictions")
         counters.inc(f"serving.evictions.{reason}")
         events.append({"type": "finished", "request": req, "reason": reason})
+        return True
 
     def _sweep(self, events):
         """Evict cancelled / past-deadline requests — active slots AND the
@@ -351,9 +396,16 @@ class LLMEngine:
                 self._finish(req, "deadline", events)
 
     def _emit(self, req, tok, events):
-        """Record one generated token; finish on EOS / length."""
+        """Record one generated token; finish on EOS / length.  The event
+        carries the token's stream index, stamped HERE where it is
+        synchronous — consumers that batch events per step (the fleet's
+        replay prefix check) see ``req.tokens`` already advanced past this
+        token when one step emits several (prefill + same-step decode)."""
         req.tokens.append(int(tok))
-        events.append({"type": "token", "request": req, "token": int(tok)})
+        with self._cond:
+            self._outstanding -= 1
+        events.append({"type": "token", "request": req, "token": int(tok),
+                       "index": len(req.tokens) - 1})
         if req.eos_token_id is not None and int(tok) == req.eos_token_id:
             self._finish(req, "eos", events)
         elif len(req.tokens) >= req.max_new_tokens:
@@ -420,6 +472,7 @@ class LLMEngine:
         active = [(s, r) for s, r in enumerate(self._slots) if r is not None]
         if not active:
             return
+        t0 = time.perf_counter()
         with span("serving.decode"):
             nxt, self._ck, self._cv, new_keys = self._decode()(
                 self._w, self._ck, self._cv,
@@ -429,6 +482,11 @@ class LLMEngine:
                 jnp.asarray(self._topp))
             nxt = np.asarray(nxt)
         self._keys = np.array(new_keys)  # mutable host copy
+        inst = len(active) / max(time.perf_counter() - t0, 1e-9)
+        with self._cond:
+            self._tps_ema = (inst if self._tps_ema <= 0 else
+                             self._ema_alpha * inst
+                             + (1 - self._ema_alpha) * self._tps_ema)
         counters.inc("serving.decode_steps")
         counters.inc("serving.decode_tokens", len(active))
         for s, req in active:
@@ -480,11 +538,16 @@ class LLMEngine:
     def drain(self):
         """Graceful shutdown: stop admitting (``add_request`` raises
         ``EngineClosed``), finish every queued + active request, return
-        them.  Idempotent."""
+        them.  Idempotent.  Queued requests that are cancelled or already
+        past their deadline are swept up front (``serving.deadline_expired``)
+        — drain never spends a prefill launch on work that can no longer
+        meet its budget."""
         self._closed = True
         with self._cond:
             self._cond.notify_all()
-        done = []
+        events = []
+        self._sweep(events)
+        done = [ev["request"] for ev in events if ev["type"] == "finished"]
         while self.has_work():
             for ev in self.step():
                 if ev["type"] == "finished":
@@ -492,13 +555,22 @@ class LLMEngine:
         return done
 
     def stats(self):
+        """Atomic snapshot under ONE lock acquisition — the fleet router
+        reads this from other threads to make dispatch/shedding decisions,
+        so the fields must be mutually consistent, never torn.
+
+        ``outstanding_tokens`` is the undelivered-token backlog (sum of
+        remaining ``max_new_tokens`` over queued + active requests);
+        ``decode_tps_ema`` is the decode tokens/s EMA over launches
+        (0.0 before the first decode)."""
         with self._cond:
-            queued = len(self._queue)
-        return {
-            "active": sum(r is not None for r in self._slots),
-            "queued": queued,
-            "free_slots": len(self._free),
-            "max_slots": self.max_slots,
-            "prefill_programs": len(self._prefill_jits),
-            "closed": self._closed,
-        }
+            return {
+                "active": sum(r is not None for r in self._slots),
+                "queued": len(self._queue),
+                "free_slots": len(self._free),
+                "max_slots": self.max_slots,
+                "prefill_programs": len(self._prefill_jits),
+                "closed": self._closed,
+                "outstanding_tokens": self._outstanding,
+                "decode_tps_ema": self._tps_ema,
+            }

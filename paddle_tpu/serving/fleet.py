@@ -1,0 +1,667 @@
+"""Elastic multi-replica serving fleet: N ``LLMEngine`` replicas behind an
+SLO-aware router, with heartbeat health-checking and fault-driven
+drain/respawn.
+
+One engine is one replica and one point of failure; the fleet makes the
+serving layer elastic the way Paddle's ``distributed/fleet`` +
+``elastic.py`` controller makes training elastic — health-check members,
+shed load the members cannot absorb, replace dead members without losing
+in-flight work:
+
+* **Dispatch** — :class:`serving.router.Router` routes each submitted
+  request to the replica with the fewest outstanding decode tokens
+  (atomic per-replica ``stats()`` snapshots; bounded per-replica queues).
+* **Load shedding** — requests whose deadline budget is already blown by
+  the estimated queue delay (decode tokens/s EMA) are refused up front
+  with a structured :class:`RetryAfter` hint instead of admitted and
+  evicted at deadline.
+* **Health** — every replica step stamps a heartbeat; the stall detector
+  declares a replica dead when it has outstanding work but its heartbeat
+  is older than ``heartbeat_timeout_s`` (``serving.fleet.heartbeat_misses``).
+* **Drain/respawn** — on replica crash (``faultinject``'s
+  ``replica_crash`` site, or any real exception out of the step loop) or
+  detected stall, a replacement replica is spawned and **warmed** (every
+  known prefill bucket + the decode program compiled) before it joins
+  dispatch, and the dead replica's in-flight requests are requeued onto
+  live replicas with **at-most-once re-prefill**: the retry reuses the
+  same request id and the same per-request PRNG seed, so the replacement
+  attempt deterministically replays the already-delivered tokens (they
+  are prefix-checked, never re-delivered) and continues the stream.  A
+  request whose retry budget is exhausted — or whose replay diverges — is
+  surfaced with ``finish_reason="retried"`` and its partial tokens.
+
+The invariant the chaos tests gate: **zero lost requests under churn** —
+every admitted request terminates with a definite ``finish_reason`` —
+and, with no faults injected, fleet output is token-identical to a
+single ``LLMEngine`` (which is itself token-identical to sequential
+``GPT.generate``).
+
+Counters: ``serving.fleet.dispatched / shed / retried / respawns /
+heartbeat_misses / replica_deaths[.reason] / completed[.reason] /
+replayed_tokens / lost`` plus the ``serving.fleet.replicas`` and
+``serving.fleet.decode_tps`` (aggregate tokens/s) gauges.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..profiler import counters
+from ..profiler.host_tracer import span
+from ..resilience import faultinject
+from .engine import (EngineBackpressure, EngineClosed, LLMEngine,
+                     bucket_length)
+from .router import RetryAfter, Router
+
+__all__ = ["FleetRequest", "Replica", "ServingFleet"]
+
+
+class FleetRequest:
+    """Stable user handle for one request, across replica retries.
+
+    The fleet-level request outlives any single engine attempt: when the
+    replica serving it dies, a fresh engine ``Request`` (same id, same
+    seed, same deadline) is created on another replica and this handle
+    keeps accumulating tokens.  ``tokens`` is the authoritative delivered
+    stream — replayed tokens from a retry are prefix-verified against it,
+    never appended twice."""
+
+    __slots__ = ("rid", "prompt", "kw", "seed", "deadline_s", "deadline",
+                 "state", "finish_reason", "error", "tokens", "retries",
+                 "replica_idx", "_er", "_lock", "_done", "_cancel")
+
+    def __init__(self, rid, prompt, kw, seed, deadline_s):
+        self.rid = rid
+        self.prompt = prompt          # np.int32 [T]
+        self.kw = kw                  # engine add_request kwargs
+        self.seed = seed              # SAME seed every attempt → replayable
+        self.deadline_s = deadline_s
+        self.deadline = (time.monotonic() + float(deadline_s)
+                         if deadline_s is not None else None)
+        self.state = "queued"         # queued | running | finished
+        # eos | length | deadline | cancelled | error | retried
+        self.finish_reason = None
+        self.error = None
+        self.tokens = []              # authoritative delivered stream
+        self.retries = 0
+        self.replica_idx = None       # replica of the current attempt
+        self._er = None               # current engine Request
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._cancel = False
+
+    @property
+    def is_finished(self):
+        return self.state == "finished"
+
+    def cancel(self):
+        """Thread-safe cancellation: flags this handle and the current
+        engine attempt; a retry of a cancelled request finishes
+        immediately."""
+        self._cancel = True
+        er = self._er
+        if er is not None:
+            er.cancel()
+
+    def wait(self, timeout=None):
+        """Block until terminal (threaded fleets); returns is_finished."""
+        return self._done.wait(timeout)
+
+    def output_ids(self):
+        """prompt + delivered tokens, as one np.int32 array."""
+        return np.concatenate([self.prompt,
+                               np.asarray(self.tokens, np.int32)])
+
+    def _on_token(self, er, tok, i):
+        """Absorb token ``i`` of the current attempt.  Tokens the fleet
+        already delivered (a retry replaying the stream from the same
+        PRNG chain) are prefix-checked and skipped; returns False on
+        divergence (the attempt must be aborted and the request surfaced
+        as ``finish_reason="retried"``).  ``i`` is the event's stamped
+        stream index — NOT derivable from ``len(er.tokens)`` here,
+        because events are absorbed after the whole engine step and one
+        step can emit several tokens (prefill + same-step decode)."""
+        with self._lock:
+            if self.state == "finished" or er is not self._er:
+                return True
+            if i < len(self.tokens):
+                if self.tokens[i] != int(tok):
+                    return False
+                counters.inc("serving.fleet.replayed_tokens")
+            else:
+                self.tokens.append(int(tok))
+                self.state = "running"
+        return True
+
+    def _finish(self, reason, error=None):
+        """Terminal CAS; True if this call made the transition."""
+        with self._lock:
+            if self.state == "finished":
+                return False
+            self.state = "finished"
+            self.finish_reason = reason
+            self.error = error
+            self._er = None
+        self._done.set()
+        counters.inc("serving.fleet.completed")
+        counters.inc(f"serving.fleet.completed.{reason}")
+        return True
+
+    def __repr__(self):
+        return (f"FleetRequest(id={self.rid}, state={self.state!r}, "
+                f"reason={self.finish_reason!r}, retries={self.retries}, "
+                f"replica={self.replica_idx}, "
+                f"delivered={len(self.tokens)})")
+
+
+class Replica:
+    """One ``LLMEngine`` + its health/lifecycle state (and, in threaded
+    fleets, its worker thread)."""
+
+    def __init__(self, idx, engine):
+        self.idx = idx
+        self.engine = engine
+        self.alive = True
+        self.warmed = False
+        self.hung = False             # decode_stall: stepping stopped
+        self.dead_reason = None       # crash | stall
+        self.steps = 0
+        self.last_beat = time.monotonic()
+        self.thread = None
+        self._kill = threading.Event()
+        self._wake = threading.Event()
+
+    def __repr__(self):
+        return (f"Replica({self.idx}, alive={self.alive}, "
+                f"steps={self.steps}, dead_reason={self.dead_reason!r})")
+
+
+class ServingFleet:
+    """N replicas behind a router; see the module docstring for design.
+
+    ``threaded=True`` (deployment shape) runs one worker thread per
+    replica plus a monitor thread; ``threaded=False`` is the
+    deterministic mode the chaos tests drive via :meth:`pump` — one
+    health-checked scheduler tick per call, replicas stepped in index
+    order in the caller's thread.
+
+    ``warm_buckets`` pre-compiles the prefill/insert programs for those
+    prompt lengths (plus the decode program) on every replica at spawn;
+    buckets seen at submit time are added to the set, so a respawned
+    replica is warmed for the live traffic mix before it joins dispatch.
+    """
+
+    def __init__(self, model, replicas=2, max_slots=4, max_seq_len=None,
+                 queue_size=64, min_bucket=8, eos_token_id=None,
+                 threaded=True, heartbeat_timeout_s=10.0, slo_margin=1.0,
+                 max_retries=1, warm_buckets=(), router=None):
+        self.model = model
+        self._engine_kw = dict(max_slots=max_slots, max_seq_len=max_seq_len,
+                               queue_size=queue_size, min_bucket=min_bucket,
+                               eos_token_id=eos_token_id)
+        self.router = router if router is not None else Router(slo_margin)
+        self.threaded = bool(threaded)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.max_retries = int(max_retries)
+        self._lock = threading.RLock()
+        self._replicas: list[Replica] = []
+        self._requests: list[FleetRequest] = []   # every admitted request
+        self._pending: deque = deque()            # retries awaiting room
+        self._closed = False
+        self._idx = itertools.count()
+        self._rid = itertools.count()
+        # probe one engine for the resolved S_max (max_seq_len may be None)
+        probe = LLMEngine(model, **self._engine_kw)
+        self._seq_len = probe.max_seq_len
+        self._min_bucket = probe.min_bucket
+        self._warm_lens = {bucket_length(int(n), self._min_bucket,
+                                         self._seq_len)
+                           for n in warm_buckets}
+        first = Replica(next(self._idx), probe)
+        self._warm(first)
+        self._install(first)
+        for _ in range(int(replicas) - 1):
+            self._spawn()
+        self._monitor_stop = threading.Event()
+        self._monitor_thread = None
+        if self.threaded:
+            self._monitor_thread = threading.Thread(
+                target=self._monitor_loop, name="fleet-monitor", daemon=True)
+            self._monitor_thread.start()
+
+    # -- replica lifecycle ---------------------------------------------------
+    def _alive(self):
+        with self._lock:
+            return [r for r in self._replicas if r.alive]
+
+    def _candidates(self):
+        return [r for r in self._alive() if r.warmed]
+
+    def _spawn(self):
+        """Create + warm a replica, then let it join dispatch."""
+        rep = Replica(next(self._idx), LLMEngine(self.model,
+                                                 **self._engine_kw))
+        self._warm(rep)
+        self._install(rep)
+        return rep
+
+    def _install(self, rep):
+        rep.warmed = True
+        with self._lock:
+            self._replicas.append(rep)
+        counters.set_gauge("serving.fleet.replicas", len(self._alive()))
+        if self.threaded:
+            rep.thread = threading.Thread(
+                target=self._worker, args=(rep,),
+                name=f"fleet-replica-{rep.idx}", daemon=True)
+            rep.thread.start()
+
+    def _warm(self, rep):
+        """Compile the replica's programs BEFORE it joins dispatch: one
+        throwaway request per known prompt bucket (prefill + insert) and
+        at least one decode launch.  A respawned replica must not pay
+        compile latency against live traffic's SLOs."""
+        if not self._warm_lens:
+            return
+        eng = rep.engine
+        with span("serving.fleet.warmup"):
+            for b in sorted(self._warm_lens):
+                n = min(int(b), self._seq_len - 2)
+                r = eng.add_request([0] * n, max_new_tokens=2, block=False)
+                while not r.is_finished:
+                    eng.step()
+                counters.inc("serving.fleet.warmup_requests")
+
+    def _respawn(self):
+        rep = self._spawn()
+        counters.inc("serving.fleet.respawns")
+        return rep
+
+    def _replica_died(self, rep, reason, exc=None):
+        """Drain a dead replica: mark it, respawn a warmed replacement,
+        and requeue its in-flight requests (at-most-once re-prefill,
+        idempotent by request id — same id, same seed, deterministic
+        token replay)."""
+        with self._lock:
+            if not rep.alive:
+                return
+            rep.alive = False
+            rep.dead_reason = reason
+        rep._kill.set()
+        counters.inc("serving.fleet.replica_deaths")
+        counters.inc(f"serving.fleet.replica_deaths.{reason}")
+        counters.set_gauge("serving.fleet.replicas", len(self._alive()))
+        eng = rep.engine
+        with eng._cond:
+            eng._closed = True
+            stranded = [r for r in eng._slots if r is not None]
+            stranded += list(eng._queue)
+            eng._queue.clear()
+            eng._cond.notify_all()
+        # the arena of a dead replica is garbage; release its HBM now
+        eng._ck = eng._cv = None
+        requeue = []
+        for er in stranded:
+            freq = er.tag
+            er.tag = None
+            if freq is None:
+                continue               # warmup request
+            with freq._lock:
+                if freq.state == "finished" or freq._er is not er:
+                    continue           # stale attempt
+                freq._er = None
+            requeue.append(freq)
+        # replacement first (warmed before joining dispatch), so survivors
+        # plus the fresh replica share the requeued load — and so requeue
+        # still works when the dead replica was the last one standing
+        if not self._closed or requeue:
+            self._respawn()
+        for freq in requeue:
+            if freq._cancel:
+                freq._finish("cancelled")
+            elif freq.retries >= self.max_retries:
+                # at-most-once re-prefill: budget exhausted → surface the
+                # partial stream instead of replaying again
+                freq._finish("retried")
+            else:
+                freq.retries += 1
+                counters.inc("serving.fleet.retried")
+                self._requeue(freq)
+
+    # -- dispatch ------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=32, do_sample=False,
+               temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+               seed=None, deadline_s=None):
+        """Route one prompt onto the least-loaded replica; returns the
+        stable :class:`FleetRequest` handle.  Raises :class:`RetryAfter`
+        (with ``queue_depth`` + ``retry_after_hint``) when admission is
+        shed — deadline budget already blown by the estimated queue
+        delay — or every replica queue is full."""
+        if self._closed:
+            raise EngineClosed("fleet is drained; no new requests")
+        ids = np.asarray(
+            prompt._data if hasattr(prompt, "_data") else prompt,
+            dtype=np.int32).reshape(-1)
+        if seed is None:
+            seed = int(np.random.randint(0, 2**31 - 1))
+        rid = next(self._rid)
+        try:
+            faultinject.maybe_fault("router_queue", rid)
+        except faultinject.InjectedFault as e:
+            counters.inc("serving.fleet.shed")
+            raise RetryAfter(
+                f"router queue fault for request {rid}: {e}",
+                queue_depth=sum(r.engine.stats()["queued"]
+                                for r in self._alive()),
+                retry_after_hint=0.0, reason="router_queue") from e
+        kw = dict(max_new_tokens=int(max_new_tokens),
+                  do_sample=bool(do_sample), temperature=float(temperature),
+                  top_k=int(top_k), top_p=float(top_p),
+                  eos_token_id=eos_token_id)
+        freq = FleetRequest(rid, ids, kw, int(seed), deadline_s)
+        est = int(ids.shape[0]) + int(max_new_tokens)
+        rep = self.router.pick(self._candidates(), est_tokens=est,
+                               deadline_s=deadline_s)
+        try:
+            self._dispatch(freq, rep)
+        except EngineBackpressure as e:
+            # lost the queue-room race with another submitter
+            raise RetryAfter(str(e), queue_depth=e.queue_depth,
+                             retry_after_hint=e.retry_after_hint,
+                             reason="backpressure") from e
+        with self._lock:
+            self._requests.append(freq)
+        self._warm_lens.add(bucket_length(int(ids.shape[0]),
+                                          self._min_bucket, self._seq_len))
+        counters.inc("serving.fleet.dispatched")
+        return freq
+
+    def _dispatch(self, freq, rep=None):
+        """Hand a fleet request to a replica engine (fresh or retry)."""
+        if rep is None:
+            rep = self.router.pick(
+                self._candidates(),
+                est_tokens=freq.kw["max_new_tokens"] - len(freq.tokens),
+                shed=False)    # requeues were admitted: never shed
+        left = None
+        if freq.deadline is not None:
+            left = max(0.0, freq.deadline - time.monotonic())
+        er = rep.engine.add_request(freq.prompt, seed=freq.seed,
+                                    deadline_s=left, block=False, **freq.kw)
+        er.tag = freq
+        with freq._lock:
+            freq._er = er
+            freq.replica_idx = rep.idx
+        if freq._cancel:
+            er.cancel()
+        rep._wake.set()
+        return rep
+
+    def _requeue(self, freq):
+        try:
+            self._dispatch(freq)
+        except (RetryAfter, EngineBackpressure, EngineClosed):
+            with self._lock:
+                self._pending.append(freq)
+
+    def _flush_pending(self, rep):
+        """Drain the fleet-level retry overflow into ``rep`` while it has
+        queue room (called from the replica's own scheduling loop)."""
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                freq = self._pending.popleft()
+            if freq.is_finished:
+                continue
+            try:
+                self._dispatch(freq, rep)
+            except (EngineBackpressure, EngineClosed):
+                with self._lock:
+                    self._pending.appendleft(freq)
+                return
+
+    # -- scheduling / health -------------------------------------------------
+    def _inject_faults(self, rep):
+        """Chaos hooks, keyed on FLEET request id so a schedule kills the
+        same point in the stream whatever replica holds the request."""
+        if not faultinject.active():
+            return
+        for er in list(rep.engine._slots):
+            freq = er.tag if er is not None else None
+            if freq is None:
+                continue
+            if faultinject.take("decode_stall", freq.rid):
+                rep.hung = True      # heartbeats stop; detector must act
+                return
+            faultinject.maybe_fault("replica_crash", freq.rid)
+
+    def _step_replica(self, rep):
+        """One health-checked scheduler iteration on one replica.
+        Returns True when the replica had work.  Crashes (injected or
+        real) propagate to the caller."""
+        if rep.hung:
+            return True
+        self._flush_pending(rep)
+        eng = rep.engine
+        if not eng.has_work():
+            rep.last_beat = time.monotonic()   # idle replica is healthy
+            return False
+        self._inject_faults(rep)
+        if rep.hung:
+            return True
+        events = eng.step()
+        rep.steps += 1
+        rep.last_beat = time.monotonic()       # per-step heartbeat
+        self._absorb(rep, events)
+        return True
+
+    def _absorb(self, rep, events):
+        """Reconcile one step's engine events into the fleet handles."""
+        for ev in events:
+            er = ev["request"]
+            freq = er.tag
+            if freq is None:
+                continue
+            if ev["type"] == "token":
+                if not freq._on_token(er, ev["token"], ev["index"]):
+                    # replay divergence: abort the attempt, surface the
+                    # already-delivered partial stream
+                    counters.inc("serving.fleet.replay_divergence")
+                    er.tag = None
+                    er.cancel()
+                    freq._finish("retried")
+            elif ev["type"] == "finished":
+                with freq._lock:
+                    stale = freq._er is not er
+                if not stale:
+                    freq._finish(er.finish_reason, er.error)
+
+    def check_health(self):
+        """The stall detector: a replica with outstanding work whose
+        heartbeat is older than ``heartbeat_timeout_s`` is declared dead
+        (``serving.fleet.heartbeat_misses``), drained, and replaced."""
+        now = time.monotonic()
+        for rep in self._alive():
+            busy = rep.hung or rep.engine.has_work()
+            if busy and now - rep.last_beat > self.heartbeat_timeout_s:
+                counters.inc("serving.fleet.heartbeat_misses")
+                self._replica_died(rep, "stall")
+
+    def pump(self):
+        """Synchronous scheduler tick (``threaded=False``): one health
+        check, then one step per alive replica in index order —
+        deterministic, so chaos schedules reproduce exactly.  Returns
+        True while any replica had work.
+
+        Heartbeats of non-hung replicas are stamped up front: in
+        synchronous mode a stale beat can only mean the CALLER paused
+        between pumps (or a respawn warmup ran long), which must not read
+        as a replica stall — only a replica that stopped progressing
+        inside the scheduler (``hung``) keeps its old beat and trips the
+        detector."""
+        now = time.monotonic()
+        for rep in self._alive():
+            if not rep.hung:
+                rep.last_beat = now
+        self.check_health()
+        progressed = False
+        for rep in self._alive():
+            try:
+                progressed |= self._step_replica(rep)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:   # incl. injected SimulatedCrash
+                self._replica_died(rep, "crash", e)
+                progressed = True
+        return progressed
+
+    def _worker(self, rep):
+        """Threaded replica loop: step while there is work, sleep-wait
+        when idle, freeze when hung (stall injection), exit on kill.  Any
+        exception — including ``SimulatedCrash`` — is this replica dying,
+        and flows through the same drain/respawn path as pump()'s."""
+        try:
+            while not rep._kill.is_set():
+                if rep.hung:
+                    rep._kill.wait(0.01)
+                    continue
+                if not self._step_replica(rep):
+                    rep._wake.wait(0.002)
+                    rep._wake.clear()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            self._replica_died(rep, "crash", e)
+
+    def _monitor_loop(self):
+        tick = max(0.01, min(0.25, self.heartbeat_timeout_s / 4))
+        while not self._monitor_stop.wait(tick):
+            try:
+                self.check_health()
+                if self._pending:
+                    for rep in self._candidates():
+                        self._flush_pending(rep)
+            except Exception:
+                counters.inc("serving.fleet.monitor_errors")
+
+    # -- conveniences --------------------------------------------------------
+    def has_work(self):
+        with self._lock:
+            if self._pending:
+                return True
+            reqs = list(self._requests)
+        if any(not f.is_finished for f in reqs):
+            return True
+        return any(r.engine.has_work() for r in self._alive())
+
+    def join(self, handles, timeout_s=300.0):
+        """Run/wait until every handle is terminal."""
+        t0 = time.monotonic()
+        while not all(h.is_finished for h in handles):
+            if self.threaded:
+                time.sleep(0.002)
+            else:
+                self.pump()
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(
+                    f"fleet.join: {sum(not h.is_finished for h in handles)}"
+                    f" requests still live after {timeout_s}s")
+        return handles
+
+    def generate(self, prompts, seeds=None, **kw):
+        """Blocking batch API mirroring ``LLMEngine.generate``: submit
+        every prompt (optionally with per-request seeds — required for
+        sampled token-identity comparisons), run to completion, return
+        the full sequences (prompt + generated) as np.int32 arrays."""
+        hs = []
+        for i, p in enumerate(prompts):
+            seed = None if seeds is None else seeds[i]
+            while True:
+                try:
+                    hs.append(self.submit(p, seed=seed, **kw))
+                    break
+                except RetryAfter as e:
+                    if self.threaded:
+                        time.sleep(e.retry_after_hint or 0.002)
+                    else:
+                        self.pump()
+        self.join(hs)
+        return [h.output_ids() for h in hs]
+
+    def drain(self):
+        """Graceful shutdown: stop admission, run every admitted request
+        to a terminal ``finish_reason``, stop workers/monitor, and audit
+        the zero-lost invariant (``serving.fleet.lost`` counts any
+        admitted request discovered non-terminal — the chaos gate pins it
+        at 0).  Returns every FleetRequest ever admitted.  Idempotent."""
+        self._closed = True
+        t0 = time.monotonic()
+        while self.has_work():
+            if self.threaded:
+                time.sleep(0.002)
+                self.check_health()
+            else:
+                self.pump()
+            if time.monotonic() - t0 > 600.0:
+                break
+        self._monitor_stop.set()
+        for rep in self._alive():
+            rep._kill.set()
+            rep._wake.set()
+        if self.threaded:
+            if self._monitor_thread is not None:
+                self._monitor_thread.join(timeout=5.0)
+            with self._lock:
+                threads = [r.thread for r in self._replicas if r.thread]
+            for t in threads:
+                t.join(timeout=5.0)
+        with self._lock:
+            reqs = list(self._requests)
+        for f in reqs:
+            if not f.is_finished:
+                counters.inc("serving.fleet.lost")
+                f._finish("error",
+                          RuntimeError("request lost at fleet drain"))
+        counters.set_gauge("serving.fleet.replicas", 0)
+        return reqs
+
+    close = drain
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.drain()
+        return False
+
+    def stats(self):
+        """Fleet-wide snapshot: per-replica atomic stats (+ health) and
+        the aggregated decode tokens/s, published to the
+        ``serving.fleet.decode_tps`` gauge."""
+        with self._lock:
+            replicas = list(self._replicas)
+            pending = len(self._pending)
+            total = len(self._requests)
+        reps, agg = [], 0.0
+        for rep in replicas:
+            st = rep.engine.stats()
+            st.update(idx=rep.idx, alive=rep.alive, hung=rep.hung,
+                      steps=rep.steps, dead_reason=rep.dead_reason)
+            reps.append(st)
+            if rep.alive:
+                agg += st["decode_tps_ema"]
+        counters.set_gauge("serving.fleet.decode_tps", agg)
+        return {"replicas": reps,
+                "alive": sum(r.alive for r in replicas),
+                "decode_tps": agg,
+                "pending_retries": pending,
+                "requests": total,
+                "unfinished": sum(1 for f in self._requests
+                                  if not f.is_finished),
+                "closed": self._closed}

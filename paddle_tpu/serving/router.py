@@ -1,0 +1,109 @@
+"""SLO-aware request router for the elastic serving fleet.
+
+The router is pure policy over per-replica ``LLMEngine.stats()``
+snapshots (each snapshot is atomic — one lock acquisition per replica —
+so a dispatch decision never reads torn state):
+
+* **Least-outstanding-tokens dispatch** — a replica's load is its
+  undelivered-token backlog (``outstanding_tokens``: remaining
+  ``max_new_tokens`` over queued + active requests), not its request
+  count, so one 512-token request weighs the same as sixteen 32-token
+  ones.  Ties break toward the lowest replica index for determinism.
+* **Bounded per-replica queues** — replicas whose admission queue is full
+  are not candidates; when every queue is full the router refuses with a
+  structured :class:`RetryAfter` instead of blocking the caller.
+* **SLO-aware admission (load shedding)** — from the chosen replica's
+  decode tokens/s EMA the router estimates when the new request would
+  *complete* (``(backlog + prompt + max_new) / tps``).  A request whose
+  deadline budget is already blown by that estimate is shed up front with
+  a ``RetryAfter`` hint (when the backlog should have drained) rather
+  than admitted, prefilled, and evicted at deadline — rejecting in O(1)
+  what would otherwise waste a prefill launch and a KV slot.  Shedding
+  only activates once an EMA exists (a cold fleet admits everything).
+
+The reference shape is Paddle's ``distributed/fleet`` elastic controller
+(health-check / scale / replace members) applied at the request-routing
+layer; the shedding rule is classic early-deadline-drop admission control.
+"""
+
+from __future__ import annotations
+
+from ..profiler import counters
+from .engine import EngineBackpressure
+
+__all__ = ["RetryAfter", "Router"]
+
+
+class RetryAfter(EngineBackpressure):
+    """Structured admission refusal from the fleet router.
+
+    ``reason`` is one of:
+
+    * ``"slo"`` — the deadline budget is already blown by the estimated
+      queue delay (load shed; counted under ``serving.fleet.shed``);
+    * ``"backpressure"`` — every replica's bounded queue is full;
+    * ``"router_queue"`` — injected ``router_queue`` fault (chaos tests).
+
+    ``queue_depth`` and ``retry_after_hint`` are inherited from
+    :class:`EngineBackpressure`; the hint says how many seconds until the
+    fleet expects to have drained enough backlog to admit the request.
+    """
+
+    def __init__(self, msg="", queue_depth=0, retry_after_hint=None,
+                 reason="slo"):
+        super().__init__(msg, queue_depth, retry_after_hint)
+        self.reason = reason
+
+
+class Router:
+    """Least-outstanding-tokens dispatch + SLO-aware load shedding.
+
+    ``slo_margin`` scales the estimated completion time before comparing
+    it to the deadline budget (>1.0 sheds earlier / more conservatively).
+    """
+
+    def __init__(self, slo_margin=1.0):
+        self.slo_margin = float(slo_margin)
+
+    def pick(self, replicas, est_tokens=0, deadline_s=None, shed=True):
+        """Choose a replica for a request costing ``est_tokens`` decode
+        tokens.  ``replicas`` is the candidate list (alive + warmed).
+        Raises :class:`RetryAfter` when every queue is full or — with
+        ``shed=True`` and a ``deadline_s`` budget — when the SLO estimate
+        says the request cannot finish in time.  Requeued (already
+        admitted) requests route with ``shed=False``: they must reach a
+        terminal state, never be shed."""
+        cands, hints, depths = [], [], []
+        for rep in replicas:
+            st = rep.engine.stats()     # atomic per-replica snapshot
+            if st["closed"]:
+                continue
+            depths.append(st["queued"])
+            if st["decode_tps_ema"] > 0:
+                hints.append(st["outstanding_tokens"]
+                             / st["decode_tps_ema"])
+            if st["queued"] >= rep.engine.queue_size:
+                continue                # bounded queue full: not a candidate
+            cands.append((st["outstanding_tokens"], rep.idx, rep, st))
+        if not cands:
+            raise RetryAfter(
+                "every replica queue is full",
+                queue_depth=min(depths) if depths else 0,
+                retry_after_hint=min(hints) if hints else None,
+                reason="backpressure")
+        cands.sort(key=lambda t: (t[0], t[1]))
+        backlog, _, rep, st = cands[0]
+        if shed and deadline_s is not None and st["decode_tps_ema"] > 0:
+            est_done_s = (backlog + est_tokens) / st["decode_tps_ema"]
+            if est_done_s * self.slo_margin > float(deadline_s):
+                counters.inc("serving.fleet.shed")
+                raise RetryAfter(
+                    f"shed: estimated completion {est_done_s:.3f}s exceeds "
+                    f"deadline budget {float(deadline_s):.3f}s "
+                    f"(backlog {backlog} tokens @ "
+                    f"{st['decode_tps_ema']:.1f} tok/s)",
+                    queue_depth=st["queued"],
+                    retry_after_hint=max(0.0, backlog
+                                         / st["decode_tps_ema"]),
+                    reason="slo")
+        return rep

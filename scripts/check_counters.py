@@ -24,7 +24,15 @@ buckets must show ``serving.retraces == 0`` and zero jit.* trace/hydrate/
 host-bind movement — continuous batching reaches the same
 zero-python-overhead steady state as training.
 
-A fourth phase gates checkpointed training (``paddle_tpu.resilience``):
+A fourth phase gates the elastic serving fleet
+(``paddle_tpu.serving.ServingFleet``): the no-fault fleet must be
+token-identical to the single engine with zero steady-state retraces
+(``warm_buckets`` pre-compiles every replica), and a churn run under a
+deterministic ``replica_crash`` schedule must show
+``serving.fleet.lost == 0`` with ``respawns``/``retried`` equal to the
+injected fault count — zero lost requests under churn.
+
+A fifth phase gates checkpointed training (``paddle_tpu.resilience``):
 a warm step interleaved with ``CheckpointManager.save`` calls must show
 zero retraces/rehydrates and zero host sync work beyond the ONE
 counter-gated ``sync()`` per save (``jit.syncs == saves``, with exactly
@@ -164,10 +172,71 @@ def run():
                        for k, want in sinvariants.items()
                        if ssteady.get(k, 0) != want})
 
+    # ---- elastic-fleet gate: zero lost under churn, warm replicas -------
+    from paddle_tpu.resilience import faultinject
+    from paddle_tpu.serving import ServingFleet
+
+    FLEET_LENS = (3, 4)   # one shared bucket {4}: one warmup compile/engine
+    fleet_prompts = [rng.randint(0, 64, size=n).tolist()
+                     for n in FLEET_LENS]
+    frefs = []
+    for p in fleet_prompts:   # single-engine reference trajectories
+        h = eng.add_request(p, max_new_tokens=3)
+        while not h.is_finished:
+            eng.step()
+        frefs.append(list(h.tokens))
+
+    fleet = ServingFleet(smodel, replicas=2, max_slots=2, max_seq_len=32,
+                         min_bucket=4, threaded=False,
+                         warm_buckets=FLEET_LENS)
+    # steady state: the no-fault fleet is token-identical to the single
+    # engine and retraces NOTHING (every replica pre-compiled its buckets)
+    flbefore = counters.snapshot()
+    fhs = [fleet.submit(p, max_new_tokens=3) for p in fleet_prompts]
+    fleet.join(fhs)
+    flsteady = counters.delta(flbefore)
+    flinvariants = {
+        "serving.retraces": 0,
+        "jit.traces": 0,
+        "serving.fleet.dispatched": len(FLEET_LENS),
+        "serving.fleet.shed": 0,
+        "serving.fleet.lost": 0,
+    }
+    violations.update({f"fleet:{k}": (flsteady.get(k, 0), want)
+                       for k, want in flinvariants.items()
+                       if flsteady.get(k, 0) != want})
+    for h, ref in zip(fhs, frefs):
+        if list(h.tokens) != ref or h.finish_reason != "length":
+            violations[f"fleet:identity@{h.rid}"] = (list(h.tokens), ref)
+
+    # churn: kill the replica decoding the first request; it must be
+    # replayed onto a survivor — zero lost, respawns == injected faults,
+    # and the delivered tokens still match the single-engine reference
+    chbefore = counters.snapshot()
+    chs = [fleet.submit(p, max_new_tokens=3) for p in fleet_prompts]
+    with faultinject.fault_schedule(f"replica_crash@{chs[0].rid}"):
+        fleet.join(chs)
+    fleet.drain()
+    chsteady = counters.delta(chbefore)
+    chinvariants = {
+        "serving.fleet.lost": 0,                 # THE durability gate
+        "serving.fleet.respawns": 1,             # == injected faults
+        "serving.fleet.retried": 1,
+        "serving.fleet.replica_deaths.crash": 1,
+        "serving.fleet.replica_deaths": 1,
+    }
+    violations.update({f"fleet-churn:{k}": (chsteady.get(k, 0), want)
+                       for k, want in chinvariants.items()
+                       if chsteady.get(k, 0) != want})
+    for h, ref in zip(chs, frefs):
+        if list(h.tokens) != ref or h.finish_reason != "length":
+            violations[f"fleet-churn:identity@{h.rid}"] = (list(h.tokens),
+                                                           ref)
+
     # ---- resilience gate 1: saves cost ONE sync each, nothing else ------
     import tempfile
     from paddle_tpu.resilience import (CheckpointManager,
-                                       FaultTolerantTrainer, faultinject)
+                                       FaultTolerantTrainer)
 
     CKPT_SAVES = 2
     CKPT_STEPS_PER_SAVE = 2
@@ -257,6 +326,9 @@ def run():
               "fused_steady_delta": fsteady,
               "serving_steady_delta": ssteady,
               "serving_prefill_programs": eng.stats()["prefill_programs"],
+              "fleet_steady_delta": flsteady,
+              "fleet_churn_delta": {k: v for k, v in chsteady.items()
+                                    if k.startswith("serving.fleet.")},
               "ckpt_steady_delta": {k: v for k, v in csteady.items()
                                     if k.startswith(("jit.", "resilience."))},
               "fault_delta": {k: v for k, v in rsteady.items()

@@ -1,0 +1,364 @@
+"""Elastic serving fleet (paddle_tpu.serving.fleet / .router).
+
+The load-bearing contract: ZERO LOST REQUESTS UNDER CHURN — every
+admitted request reaches a terminal ``finish_reason`` whatever replicas
+crash or stall — and, with no faults injected, fleet output is
+token-identical to a single ``LLMEngine`` (itself token-identical to
+sequential ``GPT.generate``).  Plus the routing/shedding policy surface:
+least-outstanding-tokens dispatch, SLO-aware ``RetryAfter`` shedding,
+heartbeat stall detection, warmed respawn, at-most-once re-prefill with
+deterministic token replay."""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import counters
+from paddle_tpu.resilience import faultinject
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=32,
+                    use_flash_attention=False)
+    paddle.seed(31)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _fleet(m, **kw):
+    from paddle_tpu.serving import ServingFleet
+    kw.setdefault("replicas", 2)
+    kw.setdefault("threaded", False)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("min_bucket", 4)
+    kw.setdefault("queue_size", 16)
+    kw.setdefault("heartbeat_timeout_s", 30.0)
+    return ServingFleet(m, **kw)
+
+
+def _ref(m, prompt, max_new, **kw):
+    """Sequential reference: the request alone through GPT.generate."""
+    out = np.asarray(m.generate(paddle.to_tensor(np.asarray([prompt])),
+                                max_new_tokens=max_new, **kw).numpy())[0]
+    return out[len(prompt):]
+
+
+@pytest.mark.slow
+class TestNoFaultIdentity:
+    def test_greedy_token_identical_to_single_engine(self, model):
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 64, size=n).tolist()
+                   for n in (5, 3, 9, 6, 11)]
+        refs = [_ref(model, p, 6) for p in prompts]
+        fleet = _fleet(model)
+        hs = [fleet.submit(p, max_new_tokens=6) for p in prompts]
+        fleet.join(hs)
+        for h, r in zip(hs, refs):
+            assert np.array_equal(h.tokens, r), (h.tokens, list(r))
+            assert h.finish_reason == "length"
+            assert h.retries == 0
+        fleet.drain()
+        assert counters.get("serving.fleet.lost") == 0
+
+    def test_sampled_token_identical_with_seeds(self, model):
+        """Per-request seeds survive routing: whatever replica serves a
+        request, its PRNG chain (and tokens) match the solo run."""
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, 64, size=n).tolist() for n in (4, 7, 11)]
+        kw = dict(do_sample=True, temperature=0.8, top_k=8, top_p=0.9)
+        refs = [_ref(model, p, 5, seed=100 + i, **kw)
+                for i, p in enumerate(prompts)]
+        fleet = _fleet(model, max_slots=1)
+        outs = fleet.generate(prompts, seeds=[100 + i for i in range(3)],
+                              max_new_tokens=5, **kw)
+        for o, p, r in zip(outs, prompts, refs):
+            assert np.array_equal(o, list(p) + list(r))
+        fleet.drain()
+
+
+class TestRouter:
+    @pytest.mark.slow
+    def test_least_outstanding_tokens_dispatch(self, model):
+        """Load is the undelivered-token backlog, not the request count:
+        the second request avoids the replica owing 20 tokens."""
+        fleet = _fleet(model, replicas=2, max_slots=1)
+        h0 = fleet.submit([1, 2, 3], max_new_tokens=20)
+        h1 = fleet.submit([4, 5, 6], max_new_tokens=2)
+        h2 = fleet.submit([7, 8, 9], max_new_tokens=2)
+        assert h0.replica_idx != h1.replica_idx
+        # h1's replica owes 2 tokens vs h0's 20 → h2 joins h1's replica
+        assert h2.replica_idx == h1.replica_idx
+        fleet.join([h0, h1, h2])
+        fleet.drain()
+
+    def test_slo_shed_returns_structured_retry_after(self, model):
+        """Once a decode tokens/s EMA exists, a request whose deadline
+        budget is blown by the estimated completion time is shed with a
+        RetryAfter carrying queue_depth + retry_after_hint."""
+        from paddle_tpu.serving import RetryAfter
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, 64, size=5).tolist() for _ in range(2)]
+        fleet = _fleet(model, replicas=1)
+        fleet.generate(prompts, max_new_tokens=4)   # primes the EMA
+        assert fleet.stats()["decode_tps"] > 0
+        before = counters.snapshot()
+        with pytest.raises(RetryAfter) as ei:
+            fleet.submit(prompts[0], max_new_tokens=16, deadline_s=1e-6)
+        assert ei.value.reason == "slo"
+        assert ei.value.queue_depth >= 0
+        assert ei.value.retry_after_hint is not None
+        assert ei.value.retry_after_hint >= 0.0
+        d = counters.delta(before)
+        assert d.get("serving.fleet.shed", 0) == 1
+        assert d.get("serving.fleet.dispatched", 0) == 0
+        # no deadline → no shedding, the same request is admitted
+        h = fleet.submit(prompts[0], max_new_tokens=16)
+        fleet.join([h])
+        assert h.finish_reason == "length"
+        fleet.drain()
+
+    @pytest.mark.slow
+    def test_cold_fleet_admits_with_deadline(self, model):
+        """No EMA yet → no shedding: the deadline is enforced by the
+        engine, not guessed by the router."""
+        fleet = _fleet(model, replicas=1)
+        h = fleet.submit([1, 2, 3, 4], max_new_tokens=4, deadline_s=60.0)
+        fleet.join([h])
+        assert h.finish_reason == "length"
+        fleet.drain()
+
+    def test_router_queue_fault_is_structured_shed(self, model):
+        from paddle_tpu.serving import RetryAfter
+        fleet = _fleet(model)
+        # the NEXT fleet rid is deterministic: count submissions so far
+        with faultinject.fault_schedule("router_queue@0"):
+            with pytest.raises(RetryAfter) as ei:
+                fleet.submit([1, 2, 3], max_new_tokens=2)
+            assert ei.value.reason == "router_queue"
+            assert faultinject.fired == [("router_queue", 0)]
+        # the fleet keeps serving afterwards
+        h = fleet.submit([1, 2, 3], max_new_tokens=2)
+        fleet.join([h])
+        assert h.finish_reason == "length"
+        fleet.drain()
+
+
+class TestChaos:
+    def test_crash_and_stall_zero_lost(self, model):
+        """THE chaos gate: a deterministic schedule kills one replica
+        mid-decode (replica_crash) and hangs the other (decode_stall,
+        caught by the heartbeat stall detector).  Every request reaches a
+        terminal finish_reason, retried == injected faults, respawns ==
+        replica deaths, zero lost, and the delivered tokens still match
+        the solo trajectories exactly (deterministic replay)."""
+        rng = np.random.default_rng(3)
+        p0 = rng.integers(0, 64, size=5).tolist()
+        p1 = rng.integers(0, 64, size=6).tolist()   # same bucket as p0
+        r0, r1 = _ref(model, p0, 6), _ref(model, p1, 6)
+        fleet = _fleet(model, max_slots=1, heartbeat_timeout_s=0.05,
+                       warm_buckets=(5,))
+        h0 = fleet.submit(p0, max_new_tokens=6)
+        h1 = fleet.submit(p1, max_new_tokens=6)
+        assert h0.replica_idx != h1.replica_idx
+        before = counters.snapshot()
+        with faultinject.fault_schedule(
+                f"replica_crash@{h0.rid};decode_stall@{h1.rid}"):
+            fleet.pump()              # admits both (prefill, 1st token)
+            fleet.pump()              # crash fires on h0's replica;
+            # stall freezes h1's replica: heartbeats stop
+            time.sleep(0.08)          # stall detector window elapses
+            fleet.join([h0, h1], timeout_s=120)
+            assert sorted(faultinject.fired) == [
+                ("decode_stall", h1.rid), ("replica_crash", h0.rid)]
+        d = counters.delta(before)
+        assert h0.finish_reason == "length"
+        assert h1.finish_reason == "length"
+        assert np.array_equal(h0.tokens, r0)
+        assert np.array_equal(h1.tokens, r1)
+        assert h0.retries == 1 and h1.retries == 1
+        assert d.get("serving.fleet.retried", 0) == 2      # == faults
+        assert d.get("serving.fleet.respawns", 0) == 2     # crash + stall
+        assert d.get("serving.fleet.replica_deaths.crash", 0) == 1
+        assert d.get("serving.fleet.replica_deaths.stall", 0) == 1
+        assert d.get("serving.fleet.heartbeat_misses", 0) == 1
+        assert d.get("serving.fleet.lost", 0) == 0
+        assert d.get("serving.fleet.replayed_tokens", 0) >= 2
+        fleet.drain()
+        assert counters.get("serving.fleet.lost") == 0
+
+    @pytest.mark.slow
+    def test_retry_is_at_most_once_then_surfaced(self, model):
+        """A request whose replica dies TWICE has burned its re-prefill
+        budget: it is surfaced as finish_reason='retried' with the partial
+        tokens delivered so far — never silently lost, never replayed a
+        second time."""
+        rng = np.random.default_rng(4)
+        p = rng.integers(0, 64, size=5).tolist()
+        ref = _ref(model, p, 6)
+        fleet = _fleet(model, replicas=2, max_slots=1, warm_buckets=(5,))
+        h = fleet.submit(p, max_new_tokens=6)
+        before = counters.snapshot()
+        with faultinject.fault_schedule(f"replica_crash@{h.rid}*2"):
+            fleet.join([h], timeout_s=120)
+        d = counters.delta(before)
+        assert h.finish_reason == "retried"
+        assert h.retries == 1                       # at-most-once
+        assert d.get("serving.fleet.retried", 0) == 1
+        assert d.get("serving.fleet.respawns", 0) == 2
+        # the partial stream is a prefix of the solo trajectory
+        assert np.array_equal(h.tokens, ref[:len(h.tokens)])
+        assert d.get("serving.fleet.lost", 0) == 0
+        fleet.drain()
+
+    @pytest.mark.slow
+    def test_queued_requests_on_dead_replica_are_requeued(self, model):
+        """A crash strands queued work too: requests waiting in the dead
+        replica's admission queue are re-dispatched, not lost."""
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, 64, size=4).tolist() for _ in range(4)]
+        refs = [_ref(model, p, 4) for p in prompts]
+        fleet = _fleet(model, replicas=2, max_slots=1, queue_size=8,
+                       warm_buckets=(4,))
+        hs = [fleet.submit(p, max_new_tokens=4) for p in prompts]
+        before = counters.snapshot()
+        with faultinject.fault_schedule(f"replica_crash@{hs[0].rid}"):
+            fleet.join(hs, timeout_s=120)
+        d = counters.delta(before)
+        assert [h.finish_reason for h in hs] == ["length"] * 4
+        for h, r in zip(hs, refs):
+            assert np.array_equal(h.tokens, r)
+        assert d.get("serving.fleet.respawns", 0) == 1
+        assert d.get("serving.fleet.retried", 0) >= 1
+        assert d.get("serving.fleet.lost", 0) == 0
+        fleet.drain()
+
+    @pytest.mark.slow
+    def test_respawned_replica_is_warm_no_steady_retraces(self, model):
+        """warm_buckets pre-compiles every replica's programs, so even
+        the FIRST request after a respawn retraces nothing — the fresh
+        replica compiled its bucketed prefill + decode programs before
+        rejoining dispatch."""
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(0, 64, size=5).tolist() for _ in range(3)]
+        fleet = _fleet(model, replicas=2, max_slots=1, warm_buckets=(5,))
+        before = counters.snapshot()
+        hs = [fleet.submit(p, max_new_tokens=3) for p in prompts]
+        fleet.join(hs)
+        assert counters.delta(before).get("serving.retraces", 0) == 0
+        h = fleet.submit(prompts[0], max_new_tokens=3)
+        with faultinject.fault_schedule(f"replica_crash@{h.rid}"):
+            fleet.join([h], timeout_s=120)
+        assert h.finish_reason == "length"
+        # post-churn steady state: the respawned replica serves warm
+        before = counters.snapshot()
+        hs = [fleet.submit(p, max_new_tokens=3) for p in prompts]
+        fleet.join(hs)
+        assert counters.delta(before).get("serving.retraces", 0) == 0
+        fleet.drain()
+
+    @pytest.mark.slow
+    def test_cancel_during_churn_terminates(self, model):
+        """Cancellation races a retry: the request still reaches exactly
+        one terminal state (cancelled), never resurrects."""
+        rng = np.random.default_rng(7)
+        p = rng.integers(0, 64, size=5).tolist()
+        fleet = _fleet(model, replicas=2, max_slots=1, warm_buckets=(5,))
+        h = fleet.submit(p, max_new_tokens=8)
+        with faultinject.fault_schedule(f"replica_crash@{h.rid}"):
+            fleet.pump()
+            fleet.pump()    # crash + requeue
+            h.cancel()
+            fleet.join([h], timeout_s=120)
+        assert h.finish_reason in ("cancelled", "retried", "length")
+        assert h.is_finished
+        fleet.drain()
+        assert counters.get("serving.fleet.lost") == 0
+
+
+@pytest.mark.slow
+class TestThreaded:
+    def test_threaded_completes_and_drains(self, model):
+        from paddle_tpu.serving import EngineClosed
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(0, 64, size=n).tolist()
+                   for n in (5, 3, 6, 4, 7)]
+        refs = [_ref(model, p, 4) for p in prompts]
+        fleet = _fleet(model, threaded=True, warm_buckets=(5, 3, 6, 4, 7))
+        hs = [fleet.submit(p, max_new_tokens=4) for p in prompts]
+        for h in hs:
+            assert h.wait(timeout=120)
+        for h, r in zip(hs, refs):
+            assert h.finish_reason == "length"
+            assert np.array_equal(h.tokens, r)
+        fleet.drain()
+        with pytest.raises(EngineClosed):
+            fleet.submit([1, 2], max_new_tokens=2)
+
+    def test_threaded_crash_recovery(self, model):
+        """Worker-thread crash flows through the same drain/respawn path:
+        all requests terminal, zero lost, one respawn."""
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(0, 64, size=5).tolist() for _ in range(4)]
+        refs = [_ref(model, p, 5) for p in prompts]
+        fleet = _fleet(model, threaded=True, max_slots=1,
+                       warm_buckets=(5,))
+        before = counters.snapshot()
+        with faultinject.fault_schedule("replica_crash@0"):
+            hs = [fleet.submit(p, max_new_tokens=5) for p in prompts]
+            fleet.join(hs, timeout_s=120)
+        d = counters.delta(before)
+        assert all(h.finish_reason == "length" for h in hs)
+        for h, r in zip(hs, refs):
+            assert np.array_equal(h.tokens, r)
+        assert d.get("serving.fleet.respawns", 0) == 1
+        assert d.get("serving.fleet.lost", 0) == 0
+        fleet.drain()
+
+
+@pytest.mark.slow
+class TestFleetSurface:
+    def test_stats_and_gauges(self, model):
+        fleet = _fleet(model)
+        h = fleet.submit([1, 2, 3, 4], max_new_tokens=3)
+        fleet.join([h])
+        st = fleet.stats()
+        assert st["alive"] == 2
+        assert st["requests"] == 1 and st["unfinished"] == 0
+        assert len(st["replicas"]) == 2
+        for rs in st["replicas"]:
+            assert {"idx", "alive", "outstanding_tokens",
+                    "decode_tps_ema"} <= set(rs)
+        assert st["decode_tps"] >= 0
+        assert counters.get("serving.fleet.replicas") == 2
+        fleet.drain()
+        assert counters.get("serving.fleet.replicas") == 0
+        assert fleet.stats()["closed"]
+
+    def test_generate_blocking_api(self, model):
+        rng = np.random.default_rng(10)
+        prompts = [rng.integers(0, 64, size=n).tolist() for n in (4, 6, 3)]
+        refs = [_ref(model, p, 4) for p in prompts]
+        fleet = _fleet(model)
+        outs = fleet.generate(prompts, max_new_tokens=4)
+        for o, p, r in zip(outs, prompts, refs):
+            assert np.array_equal(o, list(p) + list(r))
+        fleet.drain()
+
+    def test_backpressure_when_every_queue_full(self, model):
+        from paddle_tpu.serving import RetryAfter
+        fleet = _fleet(model, replicas=2, max_slots=1, queue_size=1)
+        hs = [fleet.submit([1, 2, 3], max_new_tokens=8)
+              for _ in range(2)]   # one queued per replica: both full
+        with pytest.raises(RetryAfter) as ei:
+            fleet.submit([1, 2, 3], max_new_tokens=8)
+        assert ei.value.reason == "backpressure"
+        assert ei.value.queue_depth >= 1
+        fleet.join(hs)
+        fleet.drain()

@@ -420,3 +420,96 @@ class TestGenerateExtensions:
         h = eng.add_request([1, 2, 3, 4], max_new_tokens=3)
         _run(eng, [h])
         assert len(h.tokens) == 3
+
+
+class TestFleetSatellites:
+    """Engine-level guarantees the elastic fleet layer builds on:
+    finish-CAS idempotence, atomic stats with outstanding-token
+    accounting, structured backpressure, and drain's pre-prefill sweep
+    of deadline-expired queued requests."""
+
+    def test_double_finish_is_idempotent_single_eviction(self):
+        """The fleet reaps/cancels from a different thread than the
+        replica's step loop: a racing double finish must transition once,
+        keep the first reason, and never double-release the KV slot."""
+        m = _model()
+        eng = _engine(m)
+        h = eng.add_request([1, 2, 3], max_new_tokens=4, block=False)
+        eng.step()                      # admitted: slot assigned
+        assert h.slot is not None
+        before = counters.snapshot()
+        events = []
+        assert eng._finish(h, "cancelled", events) is True
+        free0 = eng.stats()["free_slots"]
+        assert eng._finish(h, "error", events) is False   # CAS loses
+        assert h.finish_reason == "cancelled"             # first wins
+        assert eng.stats()["free_slots"] == free0
+        assert sorted(eng._free) == sorted(set(eng._free))
+        d = counters.delta(before)
+        assert d.get("serving.evictions", 0) == 1
+        assert len(events) == 1
+        eng.drain()
+
+    def test_stats_outstanding_tokens_and_tps_ema(self):
+        """stats() is one atomic snapshot; outstanding_tokens is the
+        undelivered decode-token backlog (+max_new at admission, -1 per
+        emitted token, -remainder at finish) and sums back to zero."""
+        m = _model()
+        eng = _engine(m)
+        assert eng.stats()["outstanding_tokens"] == 0
+        h1 = eng.add_request([1, 2, 3], max_new_tokens=6, block=False)
+        h2 = eng.add_request([4, 5], max_new_tokens=3, block=False)
+        assert eng.stats()["outstanding_tokens"] == 9
+        eng.step()
+        delivered = len(h1.tokens) + len(h2.tokens)
+        assert eng.stats()["outstanding_tokens"] == 9 - delivered
+        _run(eng, [h1, h2])
+        st = eng.stats()
+        assert st["outstanding_tokens"] == 0
+        assert st["decode_tps_ema"] > 0        # decode launches ran
+        # early finish returns the unspent budget, not just -1 per token
+        h3 = eng.add_request([1, 2, 3], max_new_tokens=20, block=False)
+        eng.step()
+        h3.cancel()
+        _run(eng, [h3])
+        assert eng.stats()["outstanding_tokens"] == 0
+        eng.drain()
+
+    def test_backpressure_carries_depth_and_hint(self):
+        from paddle_tpu.serving import EngineBackpressure
+        m = _model()
+        eng = _engine(m, max_slots=1, queue_size=2)
+        hs = [eng.add_request([1, 2, 3], max_new_tokens=4, block=False)
+              for _ in range(2)]
+        with pytest.raises(EngineBackpressure) as ei:
+            eng.add_request([1, 2, 3], max_new_tokens=4, block=False)
+        assert ei.value.queue_depth == 2
+        assert ei.value.retry_after_hint is None   # cold: no EMA yet
+        _run(eng, hs)
+        hs = [eng.add_request([1, 2, 3], max_new_tokens=4, block=False)
+              for _ in range(2)]
+        with pytest.raises(EngineBackpressure) as ei:
+            eng.add_request([1, 2, 3], max_new_tokens=4, block=False)
+        assert ei.value.queue_depth == 2
+        assert ei.value.retry_after_hint is not None   # backlog / tps EMA
+        assert ei.value.retry_after_hint > 0
+        _run(eng, hs)
+        eng.drain()
+
+    def test_drain_sweeps_expired_queued_without_prefill(self):
+        """drain() sweeps deadline-expired queued requests BEFORE the
+        step loop: they terminate with reason='deadline' and zero tokens
+        instead of spending a prefill launch each."""
+        m = _model()
+        eng = _engine(m, max_slots=1)
+        h1 = eng.add_request([1, 2, 3], max_new_tokens=3, block=False)
+        h2 = eng.add_request([4, 5, 6], max_new_tokens=3, block=False,
+                             deadline_s=0.0)
+        before = counters.snapshot()
+        eng.drain()
+        d = counters.delta(before)
+        assert h1.finish_reason == "length"
+        assert h2.finish_reason == "deadline"
+        assert h2.tokens == []
+        assert d.get("serving.deadline_expired", 0) == 1
+        assert d.get("serving.prefill_batches", 0) == 1   # h1 only
