@@ -29,9 +29,19 @@ Legs (perf round 5):
   killed mid-decode (``faultinject`` ``replica_crash``) — reports decode
   tokens/s for both and ``churn_retention``, and gates the durability
   invariants (zero lost requests, churn output token-identical to clean).
-Set PTPU_BENCH=125m|760m|serve|ckpt|fleet to run a single leg.
-PTPU_FUSED_STEPS sets the fused window length K (default 4; 1 disables
-the fused leg).
+- gpt125m_mesh / gpt760m_mesh (multi-chip SPMD legs): the same fused
+  training loop run mesh-native (``CompiledTrainStep(mesh=...)``, sharded
+  donated carry, data-parallel batch staging) on the ``PTPU_MESH`` mesh
+  (default ``dp2``; e.g. ``dp4`` or ``dp2mp2``), against a mesh(1) run of
+  the identical code path as the per-chip baseline.  Reports total tok/s,
+  tok/s/chip, weak-scaling efficiency ``(tok/s / n_chips) / tok/s(1)``
+  and per-chip MFU; gates zero steady-state retraces/hydrates/binds and
+  dispatches == steps/K on the mesh path, and ≥70% dp scaling efficiency
+  on real chips (forced-host CPU "devices" share cores, so the scaling
+  number is informational there).
+Set PTPU_BENCH=125m|760m|serve|ckpt|fleet|mesh|mesh760m to run a single
+leg.  PTPU_FUSED_STEPS sets the fused window length K (default 4; 1
+disables the fused leg).  PTPU_MESH picks the mesh leg's axis degrees.
 """
 
 import json
@@ -329,7 +339,156 @@ def _run_fleet_leg(cfg, replicas=2, n_requests=8, max_new=32, max_slots=4,
     return leg
 
 
+def _parse_mesh_degrees(spec):
+    """Parse a ``PTPU_MESH`` string like ``dp2``, ``dp4`` or ``dp2mp2``
+    into an ordered ``{axis_name: degree}`` dict."""
+    import re
+
+    degrees = {}
+    for name, num in re.findall(r"([a-z]+)(\d+)", (spec or "").lower()):
+        degrees[name] = int(num)
+    return degrees or {"dp": 2}
+
+
+def _run_mesh_leg(cfg, batch_per_chip, seq, iters, rounds, degrees,
+                  fused_steps=1, peak=197e12, min_scaling=None):
+    """Multi-chip SPMD leg: the same fused training loop run mesh-native
+    (``CompiledTrainStep(mesh=...)`` — sharded donated carry, batch staged
+    with data-parallel ``NamedSharding``), weak-scaled (constant per-chip
+    batch) against a mesh(1) run of the *identical* code path.  Gates the
+    steady-state counter contract on the mesh path (zero retraces /
+    rehydrates / host binds, dispatches == steps/K) and, when
+    ``min_scaling`` is set (real chips only), the dp scaling-efficiency
+    floor.  Returns the leg dict."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.io import Window
+    from paddle_tpu.jit import CompiledTrainStep
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
+    from paddle_tpu.profiler import counters
+
+    k = max(1, int(fused_steps))
+
+    def one(deg):
+        # Always carry an "mp" axis (size 1 if unrequested) so any
+        # model-declared tensor-parallel placements resolve on the mesh.
+        axes = dict(deg)
+        if "mp" not in axes:
+            axes["mp"] = 1
+        ndev = int(np.prod(list(axes.values())))
+        if jax.device_count() < ndev:
+            raise SystemExit(
+                f"mesh leg needs {ndev} devices for {deg}, have "
+                f"{jax.device_count()}")
+        mesh = Mesh(
+            np.array(jax.devices()[:ndev]).reshape(
+                tuple(axes.values())),
+            tuple(axes.keys()))
+        dp = int(np.prod([v for a, v in axes.items()
+                          if a in ("dp", "sharding")]))
+        batch = batch_per_chip * dp
+
+        paddle.seed(1234)
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+        ids = paddle.randint(0, cfg.vocab_size, [batch, seq])
+        labels = paddle.randint(0, cfg.vocab_size, [batch, seq])
+
+        def loss_fn(m, x, l):
+            return crit(m(x), l)
+
+        step = CompiledTrainStep(model, loss_fn, opt, fused_steps=k,
+                                 mesh=mesh)
+        # Stage the batch with its data-parallel sharding up front — the
+        # steady loop then re-feeds committed sharded arrays, exercising
+        # the same placement the prefetchers produce.
+        if step._batch_spec is not None:
+            sh = NamedSharding(mesh, step._batch_spec)
+            wsh = NamedSharding(mesh, P(None, *step._batch_spec))
+            ids = paddle.Tensor(jax.device_put(ids._data, sh))
+            labels = paddle.Tensor(jax.device_put(labels._data, sh))
+        if k > 1:
+            stacked = [np.stack([np.asarray(t.numpy())] * k)
+                       for t in (ids, labels)]
+            if step._batch_spec is not None:
+                stacked = [jax.device_put(s, wsh) for s in stacked]
+            win = Window(tuple(paddle.to_tensor(s) for s in stacked), k)
+            dispatch = lambda: step(win)
+        else:
+            dispatch = lambda: step(ids, labels)
+
+        t0 = time.perf_counter()
+        dispatch()
+        dispatch().numpy()
+        compile_s = time.perf_counter() - t0
+        dispatch().numpy()  # first fully cached dispatch
+
+        n_windows = max(1, iters // k)
+        before = counters.snapshot()
+        rates = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for _ in range(n_windows):
+                loss = dispatch()
+            loss.numpy()  # sync
+            dt = time.perf_counter() - t0
+            rates.append(batch * seq * k * n_windows / dt)
+        delta = counters.delta(before)
+        tps = float(np.median(rates))
+        steady = {"retraces": delta.get("jit.traces", 0),
+                  "rehydrates": delta.get("jit.hydrates", 0),
+                  "host_binds": (delta.get("jit.host.bind_layer_state", 0)
+                                 + delta.get(
+                                     "jit.host.bind_optimizer_state", 0)),
+                  "dispatches": delta.get("jit.host.dispatches", 0),
+                  "windows": rounds * n_windows}
+        if (steady["retraces"] or steady["rehydrates"]
+                or steady["host_binds"]
+                or steady["dispatches"] != steady["windows"]):
+            raise AssertionError(
+                f"mesh leg broke the steady-state counter contract on "
+                f"mesh {deg}: {steady}")
+        n_params = sum(int(np.prod(p.shape))
+                       for p in model.parameters())
+        del step, model, opt  # free HBM before the next mesh
+        return tps, ndev, n_params, round(compile_s, 4), steady
+
+    base_tps, _, _, base_compile_s, _ = one(
+        {a: 1 for a in degrees})
+    tps, ndev, n_params, compile_s, steady = one(degrees)
+    tps_chip = tps / ndev
+    eff = tps_chip / base_tps
+    leg = {"mesh": dict(degrees),
+           "n_chips": ndev,
+           "fused_steps": k,
+           "batch_per_chip": batch_per_chip,
+           "tokens_per_sec": round(tps, 2),
+           "tokens_per_sec_per_chip": round(tps_chip, 2),
+           "single_chip_tokens_per_sec": round(base_tps, 2),
+           "scaling_efficiency": round(eff, 4),
+           "mfu": round(tps_chip * 6 * n_params / peak, 4),
+           "compile_s": compile_s,
+           "single_chip_compile_s": base_compile_s,
+           "steady": steady}
+    if min_scaling is not None and eff < min_scaling:
+        raise AssertionError(
+            f"mesh leg scaling efficiency {eff:.3f} below the "
+            f"{min_scaling:.2f} floor: {leg}")
+    return leg
+
+
 def main():
+    # the mesh leg (and its CPU fallback) needs >1 device; forcing host
+    # devices is a no-op on real TPU platforms and must happen before the
+    # first jax import.
+    if ("--xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
     if os.environ.get("PTPU_BENCH_SMOKE") == "1":
         # perf-contract smoke leg: asserts steady-state steps do zero
         # host-side hydrate/bind work (see scripts/bench_smoke.py)
@@ -379,13 +538,25 @@ def main():
         out["fleet"] = _run_fleet_leg(cfg, replicas=2, n_requests=4,
                                       max_new=8, max_slots=2,
                                       min_bucket=4)
+        # tiny mesh leg: steady-state counter gates on the multi-chip
+        # SPMD path always; scaling efficiency is informational on
+        # forced-host CPU "devices" (they share the same cores)
+        if jax.device_count() >= 2:
+            out["mesh"] = _run_mesh_leg(
+                cfg, 2, 128, 4, 1,
+                _parse_mesh_degrees(os.environ.get("PTPU_MESH", "dp2")),
+                fused_steps=max(1, fused_k), peak=peak)
         print(json.dumps(out))
         return
 
     which = os.environ.get("PTPU_BENCH", "all")
-    if which not in ("all", "760m", "125m", "serve", "ckpt", "fleet"):
+    if which not in ("all", "760m", "125m", "serve", "ckpt", "fleet",
+                     "mesh", "mesh760m"):
         raise SystemExit(
-            f"PTPU_BENCH={which!r}: expected all|760m|125m|serve|ckpt|fleet")
+            f"PTPU_BENCH={which!r}: expected "
+            f"all|760m|125m|serve|ckpt|fleet|mesh|mesh760m")
+    mesh_degrees = _parse_mesh_degrees(os.environ.get("PTPU_MESH", "dp2"))
+    mesh_ndev = int(np.prod(list(mesh_degrees.values())))
     legs = {}
     if which in ("all", "760m"):
         cfg = GPTConfig.gpt3_760m(vocab_size=50304, max_seq_len=1024,
@@ -453,7 +624,43 @@ def main():
         legs["gpt125m_fleet"] = _run_fleet_leg(fcfg, replicas=2,
                                                n_requests=8, max_new=64,
                                                max_slots=4)
+    if which == "mesh" or (which == "all"
+                           and jax.device_count() >= mesh_ndev):
+        # multi-chip SPMD leg: weak-scaled fused training on the
+        # PTPU_MESH mesh vs a mesh(1) run of the same code path
+        # (acceptance: zero steady retraces, dispatches == steps/K,
+        # >=70% dp scaling efficiency)
+        mcfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
+                                   dtype="bfloat16",
+                                   use_flash_attention=True,
+                                   recompute="selective")
+        legs["gpt125m_mesh"] = _run_mesh_leg(mcfg, 16, 1024, 16, 3,
+                                             mesh_degrees,
+                                             fused_steps=max(1, fused_k),
+                                             peak=peak, min_scaling=0.70)
+    if which == "mesh760m":
+        mcfg = GPTConfig.gpt3_760m(vocab_size=50304, max_seq_len=1024,
+                                   dtype="bfloat16",
+                                   use_flash_attention=True,
+                                   recompute="selective_lean")
+        legs["gpt760m_mesh"] = _run_mesh_leg(mcfg, 8, 1024, 8, 3,
+                                             mesh_degrees,
+                                             fused_steps=max(1, fused_k),
+                                             peak=peak, min_scaling=0.70)
 
+    if set(legs) in ({"gpt125m_mesh"}, {"gpt760m_mesh"}):
+        # mesh-only run: per-chip throughput line, MFU as vs_baseline
+        name, = legs
+        leg = legs[name]
+        print(json.dumps({
+            "metric": f"{name}_train_tokens_per_sec_per_chip",
+            "value": leg["tokens_per_sec_per_chip"],
+            "unit": "tokens/s/chip",
+            "vs_baseline": leg["mfu"],  # true MFU fraction (bf16 peak)
+            "scaling_efficiency": leg["scaling_efficiency"],
+            "legs": legs,
+        }))
+        return
     if set(legs) == {"gpt125m_fleet"}:  # fleet-only run: durability line
         leg = legs["gpt125m_fleet"]
         print(json.dumps({

@@ -76,6 +76,38 @@ def _local_unique_chunks(arr):
     return chunks
 
 
+class ShardChunks:
+    """A sharded device array pre-captured as owning per-shard host chunks.
+
+    ``capture`` copies each unique local shard D2H synchronously (never the
+    assembled global array — per-shard chunks is the whole point of a
+    sharded save), so the donated device buffers are free for the next
+    train dispatch even while an async writer is still serialising.
+    ``save_state_dict`` writes the chunks exactly like live ``jax.Array``
+    shards, preserving offsets for reshard-on-load.
+    """
+
+    __slots__ = ("shape", "dtype", "spec", "chunks")
+
+    def __init__(self, shape, dtype, chunks, spec=None):
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype)
+        self.spec = spec  # PartitionSpec-as-list annotation (optional)
+        self.chunks = chunks  # [(offset, chunk_shape, owning ndarray)]
+
+    @classmethod
+    def capture(cls, arr, spec=None):
+        if isinstance(arr, Tensor):
+            arr = arr._data
+        chunks = [(off, shp, np.array(data, copy=True))
+                  for off, shp, data in _local_unique_chunks(arr)]
+        return cls(arr.shape, arr.dtype, chunks, spec=spec)
+
+    @property
+    def nbytes(self):
+        return sum(int(c.nbytes) for _, _, c in self.chunks)
+
+
 def wait_async_save():
     """Block until pending async checkpoint writes finish and surface ALL
     collected write errors, so a failed save can't masquerade as success.
@@ -178,6 +210,21 @@ def save_state_dict(state_dict, path, process_group=None,
     for k, v in flat.items():
         if isinstance(v, Tensor):
             v = v._data
+        if isinstance(v, ShardChunks):
+            # pre-captured shard chunks (sharded CheckpointManager save):
+            # the D2H copies already happened at capture time, so the
+            # writer just serialises them — no further device reads
+            entry = {"shape": list(v.shape), "dtype": str(v.dtype),
+                     "chunks": []}
+            for i, (offset, cshape, data) in enumerate(v.chunks):
+                key = f"{k}##{i}"
+                arrays[key] = data  # capture() already made owning copies
+                entry["chunks"].append({"offset": list(offset),
+                                        "shape": list(cshape),
+                                        "file": shard_file, "key": key,
+                                        "crc32": _crc32(data)})
+            meta["tensors"][k] = entry
+            continue
         if isinstance(v, (jax.Array, np.ndarray)):
             if isinstance(v, np.ndarray):
                 # host ndarrays are process-local with no global sharding:

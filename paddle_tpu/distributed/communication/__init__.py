@@ -381,6 +381,10 @@ def _instrumented(fn):
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         _counters.inc("dist.collectives")
+        # host-issued collective dispatches; GSPMD-inserted collectives
+        # inside a compiled mesh step are NOT host launches and stay at 0
+        # (the zero-host-sync invariant check_counters.py gates on)
+        _counters.inc("dist.collective_launches")
         _counters.inc(cname)
         with _tracer.span(cname):
             return fn(*args, **kwargs)

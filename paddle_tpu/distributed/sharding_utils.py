@@ -7,7 +7,11 @@ only (a) record a PartitionSpec on their weights and (b) drop
 
 from __future__ import annotations
 
+import re
+import warnings
+
 import jax
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
@@ -61,3 +65,99 @@ def param_sharding(param, mesh=None):
         return None
     spec = param.placements if param.placements is not None else P()
     return NamedSharding(mesh, spec)
+
+
+def _mesh_axis_size(mesh, axes):
+    """Product of mesh-axis sizes for one PartitionSpec entry (str or tuple)."""
+    if axes is None:
+        return 1
+    if isinstance(axes, str):
+        axes = (axes,)
+    size = 1
+    for a in axes:
+        size *= mesh.shape[a]
+    return size
+
+
+def validate_spec(spec, shape, mesh, name="<leaf>", quiet=False):
+    """Check a PartitionSpec against an array shape and a mesh.
+
+    Returns the spec unchanged when every named axis exists on the mesh and
+    every sharded dim is divisible by the product of its mesh-axis sizes;
+    otherwise warns (unless ``quiet``) and returns the replicated spec
+    ``P()``.  Keeping this a soft fallback (rather than an error) lets one
+    rule set serve several mesh shapes — an axis of size 1 still validates
+    and shards trivially.
+    """
+    def _fallback(msg):
+        if not quiet:
+            warnings.warn("infer_partition_specs: " + msg, RuntimeWarning,
+                          stacklevel=4)
+        return P()
+
+    if spec is None:
+        return P()
+    spec = P(*spec) if not isinstance(spec, P) else spec
+    if len(spec) > len(shape):
+        return _fallback(
+            f"spec {spec} for {name!r} has more entries than array rank "
+            f"{len(shape)}; using replicated")
+    for dim, axes in enumerate(spec):
+        if axes is None:
+            continue
+        names = (axes,) if isinstance(axes, str) else tuple(axes)
+        missing = [a for a in names if a not in mesh.shape]
+        if missing:
+            return _fallback(
+                f"{name!r} spec {spec} names mesh axes {missing} not in "
+                f"mesh {dict(mesh.shape)}; using replicated")
+        div = _mesh_axis_size(mesh, names)
+        if shape[dim] % div != 0:
+            return _fallback(
+                f"{name!r} dim {dim} of size {shape[dim]} not divisible by "
+                f"mesh extent {div} for spec {spec}; using replicated")
+    return spec
+
+
+def _path_str(path):
+    """Render a jax key-path as a '/'-joined string for regex matching."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def infer_partition_specs(pytree, mesh, rules, default=P()):
+    """Map every array leaf of ``pytree`` to a PartitionSpec via regex rules.
+
+    ``rules`` is an ordered sequence of ``(pattern, PartitionSpec)`` pairs;
+    the first pattern that ``re.search``-matches the leaf's '/'-joined path
+    wins.  Matched specs are validated against the leaf shape and the mesh
+    (unknown axis names or indivisible dims fall back to replicated with a
+    warning).  Unmatched leaves get ``default`` (replicated ``P()``; pass
+    ``default=None`` to signal "no rule matched" to a caller that layers
+    another source, e.g. parameter placements).
+
+    Returns a pytree of the same structure with PartitionSpec (or None)
+    leaves.
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def leaf_spec(path, leaf):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            return default
+        pstr = _path_str(path)
+        for pat, spec in compiled:
+            if pat.search(pstr):
+                return validate_spec(spec, shape, mesh, name=pstr)
+        return default
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, pytree)

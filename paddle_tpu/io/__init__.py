@@ -479,29 +479,58 @@ class DevicePrefetcher:
     replays the epoch to that position: skipped batches are pulled from the
     loader but neither staged on device nor delivered, and are counted
     under ``io.skipped_batches``.
+
+    Multi-chip: pass ``sharding`` (a ``jax.sharding.NamedSharding``,
+    typically ``NamedSharding(mesh, P("dp"))``) instead of ``device`` and
+    each batch leaf is placed data-parallel across the mesh in ONE sharded
+    ``jax.device_put`` — no per-shard host loop.  Leaves whose batch dim
+    does not divide the data axes (or whose rank is below the spec) degrade
+    to replicated-on-mesh so the device set stays uniform.  Sharded bytes
+    are tallied under ``dist.device_put_sharded_bytes``.
     """
 
-    def __init__(self, loader, depth=2, device=None, start_offset=0):
+    def __init__(self, loader, depth=2, device=None, start_offset=0,
+                 sharding=None):
         self.loader = loader
         self.depth = max(1, int(depth))
         self.device = device
+        self.sharding = sharding
         self.start_offset = max(0, int(start_offset))
         self.consumed = self.start_offset
 
     def __len__(self):
         return max(0, len(self.loader) - self.start_offset)
 
-    def _stage(self, batch):
+    def _target(self, shape):
+        """Placement target for one batch leaf: the configured sharding
+        (spec degraded to replicated when it doesn't fit the leaf), else
+        the configured device."""
+        if self.sharding is None:
+            return self.device, False
+        spec = getattr(self.sharding, "spec", None)
+        mesh = getattr(self.sharding, "mesh", None)
+        if spec is None or mesh is None:
+            return self.sharding, True
+        from jax.sharding import NamedSharding
+        from ..distributed.sharding_utils import validate_spec
+        return NamedSharding(mesh, validate_spec(spec, shape, mesh,
+                                                 quiet=True)), True
+
+    def _put(self, arr):
         import jax
+        target, sharded = self._target(arr.shape)
+        _counters.inc("io.device_put_calls")
+        _counters.inc("io.device_put_bytes", int(arr.nbytes))
+        out = jax.device_put(arr, target)
+        if sharded:
+            _counters.inc("dist.device_put_sharded_bytes", int(arr.nbytes))
+        return out
+
+    def _stage(self, batch):
         if isinstance(batch, Tensor):
-            _counters.inc("io.device_put_calls")
-            _counters.inc("io.device_put_bytes", int(batch._data.nbytes))
-            return Tensor._wrap(jax.device_put(batch._data, self.device))
+            return Tensor._wrap(self._put(batch._data))
         if isinstance(batch, (np.ndarray, np.generic)):
-            arr = np.asarray(batch)
-            _counters.inc("io.device_put_calls")
-            _counters.inc("io.device_put_bytes", int(arr.nbytes))
-            return Tensor._wrap(jax.device_put(arr, self.device))
+            return Tensor._wrap(self._put(np.asarray(batch)))
         if isinstance(batch, (list, tuple)):
             return type(batch)(self._stage(b) for b in batch)
         if isinstance(batch, dict):
@@ -587,17 +616,27 @@ class StackingPrefetcher:
     the leftover batches are emitted as a partial ``Window`` (``w.k < k``)
     — never dropped, never padded; the compiled step runs them as single
     steps.
+
+    Multi-chip: pass ``sharding`` (the per-batch data-parallel
+    ``NamedSharding``, e.g. ``NamedSharding(mesh, P("dp"))``) and batches
+    stage sharded (see ``DevicePrefetcher``); the stacked window is then
+    re-pinned to ``P(None, dp...)`` — window axis replicated, batch axis
+    sharded — which is exactly the xs layout the mesh-native fused step
+    slices per scan iteration.
     """
 
-    def __init__(self, loader, k, depth=None, device=None, start_offset=0):
+    def __init__(self, loader, k, depth=None, device=None, start_offset=0,
+                 sharding=None):
         self.loader = loader
         self.k = max(1, int(k))
         # double-buffer in window units: the next window's batches stage
         # while the current window runs
         depth = 2 * self.k if depth is None else max(1, int(depth))
         self.start_offset = max(0, int(start_offset))
+        self.sharding = sharding
         self._pref = DevicePrefetcher(loader, depth=depth, device=device,
-                                      start_offset=self.start_offset)
+                                      start_offset=self.start_offset,
+                                      sharding=sharding)
         # resume cursor in UNDERLYING batches (k per full window), counted
         # when a window is delivered — matches DevicePrefetcher.consumed
         self.consumed = self.start_offset
@@ -617,19 +656,41 @@ class StackingPrefetcher:
                     for k, v in sorted(batch.items())}
         return ("py", type(batch).__name__)
 
-    @staticmethod
-    def _stack(items):
+    def _restage(self, arr):
+        """Pin a K-stacked window leaf to the window version of the batch
+        sharding (batch spec shifted right past the new leading window
+        axis): ``jnp.stack`` over sharded inputs lets the compiler pick an
+        arbitrary output layout, and the fused step needs the stable
+        ``P(None, dp...)`` one."""
+        if self.sharding is None:
+            return arr
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec = getattr(self.sharding, "spec", None)
+        mesh = getattr(self.sharding, "mesh", None)
+        if spec is None or mesh is None:
+            return jax.device_put(arr, self.sharding)
+        from ..distributed.sharding_utils import validate_spec
+        wspec = validate_spec(PartitionSpec(None, *spec), arr.shape, mesh,
+                              quiet=True)
+        out = jax.device_put(arr, NamedSharding(mesh, wspec))
+        _counters.inc("dist.device_put_sharded_bytes", int(arr.nbytes))
+        return out
+
+    def _stack(self, items):
         import jax.numpy as jnp
         first = items[0]
         if isinstance(first, Tensor):
-            return Tensor._wrap(jnp.stack([t._data for t in items]))
+            return Tensor._wrap(self._restage(
+                jnp.stack([t._data for t in items])))
         if isinstance(first, (list, tuple)):
-            return type(first)(StackingPrefetcher._stack([b[i] for b in items])
+            return type(first)(self._stack([b[i] for b in items])
                                for i in range(len(first)))
         if isinstance(first, dict):
-            return {k: StackingPrefetcher._stack([b[k] for b in items])
+            return {k: self._stack([b[k] for b in items])
                     for k in first}
-        return Tensor._wrap(jnp.stack([jnp.asarray(x) for x in items]))
+        return Tensor._wrap(self._restage(
+            jnp.stack([jnp.asarray(x) for x in items])))
 
     def _emit(self, batches):
         with _trace.span("io.stack_window"):

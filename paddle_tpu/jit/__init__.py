@@ -21,6 +21,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as _P
 
 from ..core import flags as _flags
 from ..core.state import STATE, no_grad_guard
@@ -307,10 +308,29 @@ class CompiledTrainStep:
     Donation stays full (params/buffers/opt-state) even with the scaler: the
     skip-select reads the pre-step values INSIDE the program, so XLA aliasing
     of inputs to outputs remains legal.
+
+    Multi-chip SPMD: pass ``mesh`` (a ``jax.sharding.Mesh``) to make the
+    step mesh-native — every leaf of the donated carry (params, buffers,
+    optimizer accumulators/master weights, GradScaler state, RNG chain) is
+    placed with a ``NamedSharding`` at hydrate time and its output sharding
+    is pinned inside the traced program, so input/output layouts match and
+    donation, the retrace budget, and the zero-host-sync steady state hold
+    UNCHANGED on the mesh path (same counter gates).  Per-leaf specs
+    resolve as: ``shard_rules`` (ordered ``(regex, PartitionSpec)`` pairs
+    matched on the parameter/buffer name, see
+    ``distributed.sharding_utils.infer_partition_specs``) > the
+    PartitionSpec recorded by ``annotate_param`` (model-declared TP
+    placements, e.g. GPT's qkv/mlp ``"mp"`` splits) > replicated.  The
+    batch dimension of the step args is constrained onto ``batch_axes``
+    (default: every data-ish mesh axis — ``dp``/``sharding`` — of size >
+    1), which makes GSPMD insert the gradient all-reduce automatically:
+    dp=N training is N shards of the global batch with psum'd grads, and a
+    1-device mesh is bit-identical to the single-device path.
     """
 
     def __init__(self, model, loss_fn, optimizer, scaler=None, donate=True,
-                 fused_steps=None):
+                 fused_steps=None, mesh=None, shard_rules=None,
+                 batch_axes=None):
         import weakref
         self.model = model
         self.loss_fn = loss_fn
@@ -337,6 +357,9 @@ class CompiledTrainStep:
         self._lr_dev = None
         self._lrs_host = None  # lr vector of the last fused window
         self._lrs_dev = None
+        self.mesh = mesh
+        if mesh is not None:
+            self._init_mesh(shard_rules, batch_axes)
         # state_dict() on the model/optimizer/scaler auto-syncs through this
         model.__dict__["_train_step_owner"] = weakref.ref(self)
         optimizer.__dict__["_train_step_owner"] = weakref.ref(self)
@@ -344,6 +367,134 @@ class CompiledTrainStep:
             self.scaler.__dict__["_train_step_owner"] = weakref.ref(self)
         from ..core.state import register_param_sync_hook
         register_param_sync_hook(self.sync)
+
+    # -- mesh plumbing -------------------------------------------------------
+    def _init_mesh(self, shard_rules, batch_axes):
+        """Resolve one PartitionSpec per carry leaf.  Precedence per
+        parameter/buffer name: ``shard_rules`` regex > ``annotate_param``
+        placements > replicated; optimizer accumulators and master weights
+        inherit their parameter's spec (matched by ``id``, the accumulator
+        store key)."""
+        from ..distributed.sharding_utils import (infer_partition_specs,
+                                                  validate_spec)
+        mesh = self.mesh
+        self._rep = NamedSharding(mesh, _P())
+        if batch_axes is None:
+            batch_axes = tuple(a for a in ("dp", "sharding", "batch", "data")
+                               if a in mesh.shape and mesh.shape[a] > 1)
+        elif isinstance(batch_axes, str):
+            batch_axes = (batch_axes,)
+        self._batch_axes = tuple(batch_axes)
+        div = 1
+        for a in self._batch_axes:
+            div *= mesh.shape[a]
+        self._batch_div = div
+        self._batch_spec = (_P(self._batch_axes if len(self._batch_axes) > 1
+                               else self._batch_axes[0])
+                            if self._batch_axes else None)
+        named_p = list(self.model.named_parameters())
+        named_b = list(self.model.named_buffers())
+        flat = {k: p._data for k, p in named_p}
+        flat.update({k: b._data for k, b in named_b})
+        ruled = infer_partition_specs(flat, mesh, shard_rules or (),
+                                      default=None)
+        self._param_specs, self._buffer_specs, self._byid = {}, {}, {}
+        for k, p in named_p:
+            spec = ruled[k]
+            if spec is None:
+                placed = getattr(p, "placements", None)
+                spec = validate_spec(placed, p._data.shape, mesh, name=k,
+                                     quiet=placed is None)
+            self._param_specs[k] = spec
+            self._byid[id(p)] = spec
+        for k, b in named_b:
+            spec = ruled[k]
+            if spec is None:
+                spec = validate_spec(getattr(b, "placements", None),
+                                     b._data.shape, mesh, name=k, quiet=True)
+            self._buffer_specs[k] = spec
+
+    def _fit_spec(self, spec, shape):
+        """Quiet shape-compatibility filter used inside traced code — a
+        param-shaped spec applied to a scalar accumulator (beta pows, ...)
+        degrades to replicated without warning spam."""
+        from ..distributed.sharding_utils import validate_spec
+        return validate_spec(spec, shape, self.mesh, quiet=True)
+
+    def _pin(self, x, spec):
+        """with_sharding_constraint a traced carry leaf to its resolved
+        spec — pinning every OUTPUT leaf to the same sharding its input was
+        hydrated with keeps donation aliasing legal and the program cache
+        stable (no propagation-chosen layout drift => no retraces)."""
+        if not hasattr(x, "shape"):
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, self._fit_spec(spec, x.shape)))
+
+    def _pin_carry(self, new_params, new_buffers, new_opt):
+        new_params = {k: self._pin(v, self._param_specs.get(k))
+                      for k, v in new_params.items()}
+        new_buffers = {k: self._pin(v, self._buffer_specs.get(k))
+                       for k, v in new_buffers.items()}
+        new_opt = {
+            "acc": {an: {pid: self._pin(v, self._byid.get(pid))
+                         for pid, v in store.items()}
+                    for an, store in new_opt["acc"].items()},
+            "master": {pid: self._pin(v, self._byid.get(pid))
+                       for pid, v in new_opt["master"].items()}}
+        return new_params, new_buffers, new_opt
+
+    def _constrain_batch(self, args):
+        """Pin the leading (batch) axis of every compatible array leaf of
+        the step args to the data-parallel mesh axes, inside the traced
+        program — GSPMD then runs the forward/backward on batch shards and
+        inserts the gradient all-reduce."""
+        if self._batch_spec is None:
+            return args
+        sharding = NamedSharding(self.mesh, self._batch_spec)
+
+        def pin(x):
+            shape = getattr(x, "shape", None)
+            if (shape is None or len(shape) < 1
+                    or shape[0] % self._batch_div != 0):
+                return x
+            return jax.lax.with_sharding_constraint(x, sharding)
+
+        return jax.tree_util.tree_map(pin, args)
+
+    def _mesh_put(self, x, spec):
+        """Sharded ``device_put`` of one state leaf onto the mesh (hydrate/
+        warmup path only, never steady state)."""
+        if not hasattr(x, "shape"):
+            return x
+        sharding = NamedSharding(self.mesh, self._fit_spec(spec, x.shape))
+        if isinstance(x, jax.Array) and x.sharding == sharding:
+            return x
+        out = jax.device_put(x, sharding)
+        _counters.inc("dist.device_put_sharded_bytes",
+                      int(getattr(out, "nbytes", 0) or 0))
+        return out
+
+    def _place_mesh_state(self):
+        """Place the freshly-hydrated state tuple onto the mesh: params and
+        buffers per their resolved specs, optimizer accumulators / master
+        weights like their parameter, GradScaler state and the RNG carry
+        replicated."""
+        params, buffers, opt_state, sstate, key = self._state
+        params = {k: self._mesh_put(v, self._param_specs.get(k))
+                  for k, v in params.items()}
+        buffers = {k: self._mesh_put(v, self._buffer_specs.get(k))
+                   for k, v in buffers.items()}
+        opt_state = {
+            "acc": {an: {pid: self._mesh_put(v, self._byid.get(pid))
+                         for pid, v in store.items()}
+                    for an, store in opt_state["acc"].items()},
+            "master": {pid: self._mesh_put(v, self._byid.get(pid))
+                       for pid, v in opt_state["master"].items()}}
+        sstate = jax.tree_util.tree_map(
+            lambda v: self._mesh_put(v, None), sstate)
+        key = jax.device_put(key, self._rep)
+        self._state = (params, buffers, opt_state, sstate, key)
 
     # -- host <-> device state management -----------------------------------
     def _hydrate(self):
@@ -360,6 +511,8 @@ class CompiledTrainStep:
                            _DEFAULT_GEN.next_key())
             self._seen_version = param_version()
             self._synced = True
+            if self.mesh is not None:
+                self._place_mesh_state()
 
     def sync(self):
         """Flush the device-resident state back into the python
@@ -407,6 +560,8 @@ class CompiledTrainStep:
             params, buffers, opt_state, sstate, _ = self._state
             key = jax.random.wrap_key_data(
                 jnp.asarray(rng_carry, jnp.uint32))
+            if self.mesh is not None:
+                key = jax.device_put(key, self._rep)
             self._state = (params, buffers, opt_state, sstate, key)
         self._lr_host = self._lr_dev = None
         self._lrs_host = self._lrs_dev = None
@@ -439,6 +594,8 @@ class CompiledTrainStep:
             bind_layer_state(model, params, buffers)
             bind_optimizer_state(opt, opt_state)
             opt._learning_rate = lr
+            if self.mesh is not None:
+                args = self._constrain_batch(args)
             wargs = jax.tree_util.tree_map(
                 lambda x: Tensor._wrap(x) if isinstance(
                     x, (jax.Array, jax.core.Tracer)) else x, args)
@@ -472,6 +629,9 @@ class CompiledTrainStep:
                 new_params = _skip_select(found, params, new_params)
                 new_opt = _skip_select(found, opt_state, new_opt)
                 sstate = scaler._traced_update(sstate, found)
+            if self.mesh is not None:
+                new_params, new_buffers, new_opt = self._pin_carry(
+                    new_params, new_buffers, new_opt)
             if check_nan_inf:
                 for k, v in new_params.items():
                     checks["param:" + k] = jnp.all(jnp.isfinite(
@@ -632,6 +792,11 @@ class CompiledTrainStep:
         if self._lr_dev is None or lr_val != self._lr_host:
             self._lr_host = lr_val
             self._lr_dev = jnp.asarray(lr_val, jnp.float32)
+            if self.mesh is not None:
+                # the whole carry is mesh-committed; an uncommitted
+                # single-device lr scalar would make the dispatch mix
+                # device sets — replicate it once per scheduler value
+                self._lr_dev = jax.device_put(self._lr_dev, self._rep)
         params, buffers, opt_state, sstate, rng_key = self._state
         traces_before = _counters.get("jit.traces")
         with _trace.span("jit.dispatch"):
@@ -667,6 +832,8 @@ class CompiledTrainStep:
         if self._lrs_dev is None or lrs_t != self._lrs_host:
             self._lrs_host = lrs_t
             self._lrs_dev = jnp.asarray(lrs_t, jnp.float32)
+            if self.mesh is not None:
+                self._lrs_dev = jax.device_put(self._lrs_dev, self._rep)
         params, buffers, opt_state, sstate, rng_key = self._state
         traces_before = _counters.get("jit.traces")
         with _trace.span("jit.dispatch"):

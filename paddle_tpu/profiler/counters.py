@@ -21,6 +21,9 @@ Well-known names (see README "Observability" for the full table):
   io.stack_windows / io.stack_batches
   io.reader_ns / io.prefetch_stall_ns / io.queue_wait_ns
   dist.collectives / dist.<op> / dist.mp_collectives
+  dist.collective_launches (host-issued collective dispatches)
+  dist.device_put_sharded_bytes (bytes placed via sharded device_put:
+      mesh hydrate + data-parallel batch/window staging)
   optimizer.steps
   serving.requests / serving.prefill_batches / serving.decode_steps
   serving.decode_tokens / serving.evictions / serving.evictions.<reason>
@@ -37,6 +40,7 @@ Well-known names (see README "Observability" for the full table):
   serving.fleet.lost (admitted request without terminal state; MUST be 0)
   serving.fleet.replicas / serving.fleet.decode_tps (gauges)
   resilience.saves / resilience.save_ms / resilience.restores
+  resilience.resharded_restores (restores onto a different mesh shape)
   resilience.retries / resilience.corrupt_detected
   resilience.recoveries / resilience.recovered.<ExcType>
   resilience.save_failures / resilience.gc_removed

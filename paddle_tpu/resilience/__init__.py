@@ -26,11 +26,12 @@ gc_removed`` (+ ``io.skipped_batches`` from replay).
 """
 
 from . import faultinject  # noqa: F401
-from .manager import (CheckpointCorrupt, CheckpointManager,  # noqa: F401
-                      CheckpointWriteError)
+from .manager import (CheckpointCorrupt, CheckpointLayoutError,  # noqa: F401
+                      CheckpointManager, CheckpointWriteError)
 from .trainer import FaultTolerantTrainer, NonFiniteLossError  # noqa: F401
 
 __all__ = [
-    "CheckpointManager", "CheckpointCorrupt", "CheckpointWriteError",
+    "CheckpointManager", "CheckpointCorrupt", "CheckpointLayoutError",
+    "CheckpointWriteError",
     "FaultTolerantTrainer", "NonFiniteLossError", "faultinject",
 ]

@@ -66,12 +66,51 @@ class CheckpointWriteError(RuntimeError):
     """A checkpoint save failed permanently (retries exhausted)."""
 
 
+class CheckpointLayoutError(RuntimeError):
+    """The checkpoint's array layout is incompatible with the live training
+    state (leaf shape mismatch — a different model, not a different mesh).
+    Deliberately NOT a fallback-to-older-checkpoint condition: every older
+    save of the same run would mismatch the same way, so the manager raises
+    immediately instead of silently restoring nothing.  Mere mesh-shape
+    differences do NOT raise — restore reshards (see ``resharded`` in the
+    restore info)."""
+
+
 def _np(x):
     """Force an owning host copy (the device buffer may be donated to the
     very next dispatch while an async writer is still serialising)."""
     if isinstance(x, Tensor):
         x = x._data
     return np.array(x, copy=True)
+
+
+def _capture(x):
+    """Snapshot one state leaf for the writer: a multi-device array becomes
+    per-shard host chunks (synchronous D2H of each unique local shard —
+    never a gathered global copy), anything else a plain owning ndarray."""
+    import jax
+    data = x._data if isinstance(x, Tensor) else x
+    if isinstance(data, jax.Array) and len(data.sharding.device_set) > 1:
+        return _dckpt.ShardChunks.capture(data)
+    return _np(data)
+
+
+def _mesh_desc(mesh):
+    """JSON-able mesh identity recorded in the manifest (axis names +
+    sizes), compared on restore to detect resharding."""
+    if mesh is None:
+        return None
+    return {"axis_names": [str(a) for a in mesh.axis_names],
+            "shape": [int(mesh.shape[a]) for a in mesh.axis_names]}
+
+
+def _spec_json(spec):
+    """PartitionSpec -> JSON (None | axis-name | [axis-names] per dim)."""
+    if spec is None:
+        return None
+    return [None if axes is None
+            else (axes if isinstance(axes, str) else [str(a) for a in axes])
+            for axes in spec]
 
 
 def _param_names(optimizer):
@@ -165,37 +204,74 @@ class CheckpointManager:
         no further host bind work.
         """
         carry = train_step.export_resume_state()
+        opt = train_step.optimizer
+        mesh = getattr(train_step, "mesh", None)
         model_sd = train_step.model.state_dict()
-        opt_sd = train_step.optimizer.state_dict()
-        # optimizer state_dict keys are param NAMES, which for auto-named
-        # params ("generated_tensor_N") depend on a process-global counter
-        # — a restarted process numbers them differently.  Checkpoint keys
-        # must be the param's POSITION in the parameter list, which is
-        # construction order and stable across restarts.
-        pindex = {n: f"p{i}" for i, n in enumerate(_param_names(
-            train_step.optimizer))}
         arrays = {"rng/carry": carry,
                   "rng/host": _np(default_generator().get_state())}
+        specs = {}
         for name, t in model_sd.items():
-            arrays[f"model/{name}"] = _np(t)
-        for accname, store in opt_sd["accumulators"].items():
-            for pname, v in store.items():
-                arrays[f"opt/acc/{accname}/{pindex.get(pname, pname)}"] = \
-                    _np(v)
-        for pname, v in opt_sd["master_weights"].items():
-            arrays[f"opt/master/{pindex.get(pname, pname)}"] = _np(v)
+            key = f"model/{name}"
+            # post-sync, state_dict tensors wrap the live (possibly mesh-
+            # sharded) device arrays: multi-device leaves save as per-shard
+            # chunks, single-device leaves as before
+            arrays[key] = _capture(t)
+            if mesh is not None:
+                smap = getattr(train_step, "_param_specs", {})
+                bmap = getattr(train_step, "_buffer_specs", {})
+                specs[key] = _spec_json(smap.get(name, bmap.get(name)))
+        if mesh is not None and train_step._state is not None:
+            # sharded save: read accumulators/master weights straight from
+            # the device-resident carry (optimizer.state_dict() would
+            # gather every leaf to one host ndarray — the opposite of a
+            # per-shard save); keys stay positional "p<i>" exactly like the
+            # host path below, so restore is layout-agnostic
+            pos = {id(p): f"p{i}"
+                   for i, p in enumerate(opt._parameter_list or [])}
+            byid = getattr(train_step, "_byid", {})
+            dev_opt = train_step._state[2]
+            for accname, store in dev_opt["acc"].items():
+                for pid, v in store.items():
+                    key = f"opt/acc/{accname}/{pos.get(pid, str(pid))}"
+                    arrays[key] = _capture(v)
+                    specs[key] = _spec_json(byid.get(pid))
+            for pid, v in dev_opt["master"].items():
+                key = f"opt/master/{pos.get(pid, str(pid))}"
+                arrays[key] = _capture(v)
+                specs[key] = _spec_json(byid.get(pid))
+            lr = opt._learning_rate
+            opt_step = int(opt._step_count)
+            lr_sd = lr.state_dict() if hasattr(lr, "state_dict") else None
+        else:
+            opt_sd = opt.state_dict()
+            # optimizer state_dict keys are param NAMES, which for auto-
+            # named params ("generated_tensor_N") depend on a process-global
+            # counter — a restarted process numbers them differently.
+            # Checkpoint keys must be the param's POSITION in the parameter
+            # list, which is construction order and stable across restarts.
+            pindex = {n: f"p{i}" for i, n in enumerate(_param_names(opt))}
+            for accname, store in opt_sd["accumulators"].items():
+                for pname, v in store.items():
+                    arrays[f"opt/acc/{accname}/"
+                           f"{pindex.get(pname, pname)}"] = _np(v)
+            for pname, v in opt_sd["master_weights"].items():
+                arrays[f"opt/master/{pindex.get(pname, pname)}"] = _np(v)
+            opt_step = int(opt_sd.get("step", 0))
+            lr_sd = opt_sd.get("LR_Scheduler") or None
         host = {"global_step": global_step,
                 "cursor": dict(cursor or {}),
-                "opt_step": int(opt_sd.get("step", 0)),
-                "lr_scheduler": opt_sd.get("LR_Scheduler") or None,
+                "opt_step": opt_step,
+                "lr_scheduler": lr_sd,
                 "scheduler": (scheduler.state_dict()
                               if scheduler is not None else None),
                 "scaler": (train_step.scaler.state_dict()
                            if train_step.scaler is not None else None),
                 "fused_steps": int(getattr(train_step, "fused_steps", 1))}
         manifest = {"format": 1, "step": global_step, "host": host,
+                    "mesh": _mesh_desc(mesh),
                     "arrays": {k: {"shape": list(v.shape),
-                                   "dtype": str(v.dtype)}
+                                   "dtype": str(v.dtype),
+                                   "spec": specs.get(k)}
                                for k, v in arrays.items()}}
         return arrays, manifest
 
@@ -303,6 +379,14 @@ class CheckpointManager:
         with open(os.path.join(path, _MANIFEST)) as f:
             manifest = json.load(f)
         host = manifest["host"]
+        saved_mesh = manifest.get("mesh")
+        live_mesh_desc = _mesh_desc(getattr(train_step, "mesh", None))
+        # resharding is detected from the manifest's recorded mesh identity
+        # (and performed below: chunks reassemble under the LIVE mesh's
+        # shardings at re-hydrate); an incompatible LAYOUT — different leaf
+        # shapes — is a different model and raises immediately
+        resharded = (saved_mesh != live_mesh_desc
+                     and (saved_mesh or live_mesh_desc) is not None)
         # flush + drop device state FIRST: the bump_param_version calls
         # below must not rebind stale pre-restore arrays over loaded data
         train_step.invalidate()
@@ -315,11 +399,22 @@ class CheckpointManager:
                     raise KeyError(
                         f"checkpoint tensor {key!r} has no target in the "
                         "live model")
-                targets[key] = model_sd[name]
+                tgt = model_sd[name]
+                if tuple(tgt.shape) != tuple(spec["shape"]):
+                    raise CheckpointLayoutError(
+                        f"checkpoint leaf {key!r} has shape "
+                        f"{tuple(spec['shape'])} (saved on mesh "
+                        f"{saved_mesh}, spec {spec.get('spec')}), but the "
+                        f"live model tensor is {tuple(tgt.shape)} on mesh "
+                        f"{live_mesh_desc} — incompatible layout, not a "
+                        "resharding; refusing to restore")
+                targets[key] = tgt
             else:
                 targets[key] = Tensor._wrap(jnp.zeros(
                     tuple(spec["shape"]), dtype=spec["dtype"]))
         _dckpt.load_state_dict(targets, path)  # verifies per-chunk crc32
+        if resharded:
+            _counters.inc("resilience.resharded_restores")
         # optimizer: reassemble the name-keyed state dict it expects,
         # translating the checkpoint's positional "p<i>" keys back to THIS
         # process's live param names (see _snapshot)
@@ -360,4 +455,6 @@ class CheckpointManager:
             jnp.asarray(np.asarray(targets["rng/host"]._data), jnp.uint32))
         return {"step": int(manifest["step"]),
                 "cursor": dict(host.get("cursor") or {}),
-                "path": path}
+                "path": path,
+                "resharded": bool(resharded),
+                "saved_mesh": saved_mesh}

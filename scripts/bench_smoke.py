@@ -26,6 +26,14 @@ sequential per-request ``GPT.generate``; it reports decode tokens/s for
 both paths (the speedup is informational on CPU — the batching win is a
 TPU property).
 
+A mesh phase (on >=2 devices — forced host devices under
+``XLA_FLAGS=--xla_force_host_platform_device_count=8``) re-runs the same
+fused GPT mesh-native on a dp=2 mesh (``CompiledTrainStep(mesh=...)``,
+batch staged with data-parallel ``NamedSharding``) and gates the
+multi-chip economics: a steady fused window is still exactly ONE XLA
+dispatch with zero retraces, and the losses match the single-device
+fused run (GSPMD gradient averaging is numerically invisible).
+
 Run directly (``python scripts/bench_smoke.py``), via ``PTPU_BENCH_SMOKE=1
 python bench.py``, or through tests/test_train_step_state.py (tier-1).
 """
@@ -36,6 +44,13 @@ import os
 
 def run():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the mesh phase needs >1 device; only effective before the first jax
+    # import, no-op on real TPUs
+    if ("--xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
     import numpy as np
     import paddle_tpu as paddle
     import paddle_tpu.jit as pjit
@@ -155,6 +170,48 @@ def run():
     serve_tps = decode_tokens / max(serve_s, 1e-9)
     seq_tps = decode_tokens / max(seq_s, 1e-9)
 
+    # ---- mesh: fused dp=2 SPMD keeps the launch economics + the loss ----
+    import jax
+    if jax.device_count() >= 2:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1),
+                    ("dp", "mp"))
+        paddle.seed(0)
+        mmodel = GPTForCausalLM(cfg)
+        mopt = paddle.optimizer.AdamW(1e-4,
+                                      parameters=mmodel.parameters())
+        mstep = pjit.CompiledTrainStep(mmodel, loss_fn, mopt,
+                                       fused_steps=fused_k, mesh=mesh)
+        # stage the window with its data-parallel sharding up front, the
+        # way the sharded prefetchers do (batch axis is dim 1 of a window)
+        wsh = NamedSharding(mesh, P(None, *mstep._batch_spec))
+        mwin = Window(
+            tuple(paddle.Tensor(jax.device_put(t._data, wsh))
+                  for t in (wids, wlabels)), fused_k)
+        mstep(mwin).numpy()   # window 1: priming single-step fallback
+        mstep(mwin).numpy()   # window 2: scan compile
+        mbefore = counters.snapshot()
+        mlosses = [round(float(l), 6)
+                   for l in np.asarray(mstep(mwin).numpy())]
+        mdelta = counters.delta(mbefore)
+        mesh_phase = {
+            "mesh_devices": 2,
+            "mesh_window_dispatches": mdelta.get("jit.host.dispatches",
+                                                 0),
+            "mesh_window_steps": mdelta.get("jit.steps", 0),
+            "mesh_window_retraces": mdelta.get("jit.traces", 0),
+            "mesh_window_rehydrates": mdelta.get("jit.hydrates", 0),
+            "mesh_sharded_put_bytes": counters.get(
+                "dist.device_put_sharded_bytes", 0),
+            "mesh_losses": mlosses,
+            "mesh_losses_match": bool(np.allclose(mlosses, flosses,
+                                                  rtol=1e-4, atol=1e-5)),
+        }
+    else:
+        mesh_phase = {"mesh_devices": jax.device_count(),
+                      "mesh_skipped": "needs 2 devices"}
+
     result = {"metric": "steady_state_host_syncs",
               "value": sum(host_delta.values()),
               "unit": "calls/2 steps",
@@ -185,6 +242,7 @@ def run():
               "serve_outputs_match_generate": outputs_match,
               "serve_steady_retraces": sdelta.get("serving.retraces", 0),
               "serve_prefill_programs": eng.stats()["prefill_programs"]}
+    result.update(mesh_phase)
     print(json.dumps(result))
     if sum(host_delta.values()) != 0:
         raise AssertionError(
@@ -235,6 +293,23 @@ def run():
             "warm serving pass retraced: serving.retraces += "
             f"{result['serve_steady_retraces']} (bucketed prefill should "
             "reuse every compiled program)")
+    if "mesh_skipped" not in mesh_phase:
+        if (mesh_phase["mesh_window_dispatches"] != 1
+                or mesh_phase["mesh_window_steps"] != fused_k
+                or mesh_phase["mesh_window_retraces"] != 0
+                or mesh_phase["mesh_window_rehydrates"] != 0):
+            raise AssertionError(
+                "mesh fused-dispatch economics violated: a steady dp=2 "
+                f"window must be 1 XLA dispatch / {fused_k} steps with "
+                f"zero retraces/rehydrates, got {mesh_phase}")
+        if not mesh_phase["mesh_losses_match"]:
+            raise AssertionError(
+                "mesh dp=2 losses diverged from the single-device fused "
+                f"run: {mesh_phase['mesh_losses']} vs {flosses}")
+        if mesh_phase["mesh_sharded_put_bytes"] <= 0:
+            raise AssertionError(
+                "mesh phase staged no sharded bytes — "
+                "dist.device_put_sharded_bytes never moved")
     return result
 
 

@@ -41,6 +41,16 @@ one ``bind_layer_state``/``bind_optimizer_state`` pair each and zero
 ``FaultTolerantTrainer`` run under a deterministic fault schedule must
 show ``resilience.restores == injected preemptions``.
 
+A sixth phase gates the multi-chip SPMD mesh path
+(``CompiledTrainStep(mesh=...)``): on >=4 devices (forced host devices in
+CI) a 2x2 dp/mp mesh with a ``shard_rules`` tensor-parallel split must
+prove its weights actually live sharded (local shard shape check), reach
+the SAME steady-state economics as the single-device path — zero
+retraces / rehydrates / host binds, ``dispatches == MEASURE``, and
+``dist.collective_launches == 0`` (GSPMD collectives are compiled into
+the program, never host-issued) — and the fused-on-mesh run must keep
+``dispatches == steps/K``.
+
 Prints one JSON line; raises AssertionError on any violation.  Wired as a
 tier-1 test via tests/test_profiler.py.  Run directly:
 ``python scripts/check_counters.py``.
@@ -59,6 +69,13 @@ SERVE_LENS_MEASURE = (4, 5)   # same buckets — must retrace NOTHING
 
 def run():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the mesh gate needs >1 device; only effective before the first jax
+    # import (tests/conftest.py sets the same flag), no-op on real TPUs
+    if ("--xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
     import paddle_tpu as paddle
     import paddle_tpu.jit as pjit
     import paddle_tpu.nn as nn
@@ -134,6 +151,71 @@ def run():
     violations.update({f"fused:{k}": (fsteady.get(k, 0), want)
                        for k, want in finvariants.items()
                        if fsteady.get(k, 0) != want})
+
+    # ---- mesh gate: the multi-chip SPMD path keeps the same economics ---
+    import jax
+    if jax.device_count() >= 4:
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("dp", "mp"))
+        paddle.seed(0)
+        mmodel = nn.Sequential(nn.Linear(16, 32), nn.GELU(),
+                               nn.Linear(32, 4))
+        mopt = paddle.optimizer.AdamW(1e-3,
+                                      parameters=mmodel.parameters())
+        mstep = pjit.CompiledTrainStep(
+            mmodel, loss_fn, mopt, mesh=mesh,
+            shard_rules=[(r"\.weight$", P(None, "mp"))])
+        for _ in range(WARMUP):
+            mstep(x, y).numpy()
+        # sharded-placement proof: the (16, 32) Linear weight split over
+        # mp=2 must live as (16, 16) local shards, not a replicated copy.
+        # The live weights sit in the donated carry (mstep._state), not in
+        # the model's stale host-bound params.
+        w = next(v for v in jax.tree_util.tree_leaves(mstep._state[0])
+                 if tuple(v.shape) == (16, 32))
+        shard_shape = tuple(w.addressable_shards[0].data.shape)
+        if shard_shape != (16, 16):
+            violations["mesh:weight_shard_shape"] = (shard_shape,
+                                                     (16, 16))
+        mbefore = counters.snapshot()
+        for _ in range(MEASURE):
+            mstep(x, y).numpy()
+        msteady = counters.delta(mbefore)
+        minvariants = dict(invariants)
+        # GSPMD collectives are compiled into the step program — the
+        # steady state must issue ZERO host-side collective launches
+        minvariants["dist.collective_launches"] = 0
+        violations.update({f"mesh:{k}": (msteady.get(k, 0), want)
+                           for k, want in minvariants.items()
+                           if msteady.get(k, 0) != want})
+
+        # fused-on-mesh: one XLA launch per K-step window, same as the
+        # single-device fused gate
+        paddle.seed(0)
+        fmmodel = nn.Sequential(nn.Linear(16, 32), nn.GELU(),
+                                nn.Linear(32, 4))
+        fmopt = paddle.optimizer.AdamW(1e-3,
+                                       parameters=fmmodel.parameters())
+        fmstep = pjit.CompiledTrainStep(
+            fmmodel, loss_fn, fmopt, fused_steps=FUSED_K, mesh=mesh,
+            shard_rules=[(r"\.weight$", P(None, "mp"))])
+        fmstep(window()).numpy()  # priming single-step fallback
+        fmstep(window()).numpy()  # scan compile
+        fmbefore = counters.snapshot()
+        for _ in range(FUSED_MEASURE):
+            fmstep(window()).numpy()
+        fmsteady = counters.delta(fmbefore)
+        fminvariants = dict(finvariants)
+        fminvariants["dist.collective_launches"] = 0
+        violations.update({f"mesh-fused:{k}": (fmsteady.get(k, 0), want)
+                           for k, want in fminvariants.items()
+                           if fmsteady.get(k, 0) != want})
+    else:
+        msteady = {"skipped":
+                   f"needs 4 devices, have {jax.device_count()}"}
+        fmsteady = msteady
 
     # ---- serving steady-state gate: warm buckets never retrace ----------
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
@@ -324,6 +406,8 @@ def run():
                              for k, (got, want) in violations.items()},
               "steady_delta": steady,
               "fused_steady_delta": fsteady,
+              "mesh_steady_delta": msteady,
+              "mesh_fused_delta": fmsteady,
               "serving_steady_delta": ssteady,
               "serving_prefill_programs": eng.stats()["prefill_programs"],
               "fleet_steady_delta": flsteady,

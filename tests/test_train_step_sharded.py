@@ -1,0 +1,348 @@
+"""Multi-chip SPMD train step: mesh-native CompiledTrainStep contract.
+
+Covers the mesh promotion of the device-resident train state (runs on the
+forced 8-device CPU backend — see conftest.py):
+  * mesh(1,1) is BIT-identical to the single-device path (the mesh
+    machinery adds no numerics);
+  * dp=2 gradient sync matches the single-device full-batch step (GSPMD
+    gradient averaging is numerically invisible up to fp associativity);
+  * shard_rules / parameter placements really shard the donated carry —
+    params AND optimizer moments live as local shards, and donation still
+    consumes the previous carry;
+  * fused_steps=K on a mesh keeps the launch economics (one XLA dispatch
+    per K-step window) and the single-step losses;
+  * the steady-state counter gates (zero retraces / rehydrates / host
+    binds) hold unchanged on the mesh path;
+  * ``infer_partition_specs`` rule resolution (first match wins, soft
+    fallback to replicated on invalid axes / indivisible dims);
+  * the sharded prefetchers stage batches data-parallel in one sharded
+    ``device_put`` with values bit-identical to the plain loader.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as pjit
+import paddle_tpu.nn as nn
+from paddle_tpu.profiler import counters
+
+
+def _mse(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+def _mesh(*shape, axes=("dp", "mp")):
+    need = int(np.prod(shape))
+    if jax.device_count() < need:
+        pytest.skip(f"needs {need} devices")
+    return Mesh(np.array(jax.devices()[:need]).reshape(shape), axes)
+
+
+def _make(mesh=None, rules=None, fused=1, scaler=None, opt_cls=None):
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    opt_cls = opt_cls or paddle.optimizer.AdamW
+    opt = opt_cls(learning_rate=1e-2, parameters=net.parameters())
+    step = pjit.CompiledTrainStep(net, _mse, opt, fused_steps=fused,
+                                  mesh=mesh, shard_rules=rules,
+                                  scaler=scaler)
+    return net, opt, step
+
+
+def _data(n=6, b=8):
+    rng = np.random.RandomState(0)
+    return ([rng.randn(b, 8).astype("float32") for _ in range(n)],
+            [rng.randn(b, 4).astype("float32") for _ in range(n)])
+
+
+def _run(step, xs, ys):
+    return [float(step(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+            for x, y in zip(xs, ys)]
+
+
+class TestMeshTrainStep:
+    def test_mesh11_bit_identical_to_single_device(self):
+        xs, ys = _data()
+        _, _, s0 = _make()
+        l0 = _run(s0, xs, ys)
+        _, _, s1 = _make(mesh=_mesh(1, 1))
+        assert _run(s1, xs, ys) == l0
+
+    def test_dp2_matches_single_device(self):
+        xs, ys = _data()
+        _, _, s0 = _make()
+        l0 = _run(s0, xs, ys)
+        _, _, s2 = _make(mesh=_mesh(2, 1))
+        l2 = _run(s2, xs, ys)
+        # dp splits the batch; GSPMD averages the per-shard grads — only
+        # fp summation order may differ
+        assert np.allclose(l0, l2, rtol=1e-5, atol=1e-6)
+
+    def test_dp2_gradient_sync_parity(self):
+        # one optimizer step from identical init: dp=2 updated params must
+        # match the single-device full-batch update (the gradient the
+        # optimizer saw is the same mean over all rows)
+        xs, ys = _data(n=1)
+        _, _, s0 = _make()
+        s0(paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0])).numpy()
+        _, _, s2 = _make(mesh=_mesh(2, 1))
+        s2(paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0])).numpy()
+        p0 = {k: np.asarray(v) for k, v in s0._state[0].items()}
+        p2 = {k: np.asarray(v) for k, v in s2._state[0].items()}
+        assert p0.keys() == p2.keys()
+        for k in p0:
+            assert np.allclose(p0[k], p2[k], rtol=1e-5, atol=1e-6), k
+
+    def test_rules_shard_params_and_optimizer_state(self):
+        mesh = _mesh(2, 2)
+        xs, ys = _data(n=2)
+        _, _, step = _make(mesh=mesh,
+                           rules=[(r"\.weight$", P(None, "mp"))])
+        _run(step, xs, ys)
+        w = step._state[0]["0.weight"]
+        assert w.sharding.spec == P(None, "mp")
+        # (8, 16) over mp=2 → (8, 8) local shards
+        assert tuple(w.addressable_shards[0].data.shape) == (8, 8)
+        # Adam moments inherit the param's spec (sharded state, not a
+        # replicated shadow copy)
+        m1 = step._state[2]["acc"]["moment1"]
+        specs = {getattr(v.sharding, "spec", None)
+                 for v in m1.values()
+                 if hasattr(v, "sharding") and len(v.shape) == 2
+                 and v.shape == (8, 16)}
+        assert P(None, "mp") in specs
+
+    def test_donation_consumes_previous_sharded_carry(self):
+        xs, ys = _data(n=3)
+        _, _, step = _make(mesh=_mesh(2, 1),
+                           rules=[(r"\.weight$", P(None, "mp"))])
+        step(paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0])).numpy()
+        step(paddle.to_tensor(xs[1]), paddle.to_tensor(ys[1])).numpy()
+        held = step._state[0]["0.weight"]
+        step(paddle.to_tensor(xs[2]), paddle.to_tensor(ys[2])).numpy()
+        assert held.is_deleted()  # buffer was donated, not copied
+
+    def test_steady_state_counters_on_mesh(self):
+        xs, ys = _data()
+        _, _, step = _make(mesh=_mesh(2, 1))
+        _run(step, xs[:3], ys[:3])  # hydrate + both trace structures
+        before = counters.snapshot()
+        _run(step, xs[3:], ys[3:])
+        d = counters.delta(before)
+        assert d.get("jit.traces", 0) == 0
+        assert d.get("jit.hydrates", 0) == 0
+        assert d.get("jit.syncs", 0) == 0
+        assert d.get("jit.host.bind_layer_state", 0) == 0
+        assert d.get("jit.host.bind_optimizer_state", 0) == 0
+        assert d.get("jit.host.dispatches", 0) == 3
+        assert d.get("jit.cache_hits", 0) == 3
+        # GSPMD collectives are compiled into the program, never
+        # host-issued
+        assert d.get("dist.collective_launches", 0) == 0
+
+    def test_fused_on_mesh_bit_identical_and_one_dispatch(self):
+        from paddle_tpu.io import Window
+        mesh = _mesh(2, 1)
+        xs, ys = _data(n=8)
+        _, _, s1 = _make(mesh=mesh)
+        l1 = _run(s1, xs, ys)
+        _, _, s2 = _make(mesh=mesh, fused=2)
+
+        def win(i):
+            return Window((paddle.to_tensor(np.stack(xs[i:i + 2])),
+                           paddle.to_tensor(np.stack(ys[i:i + 2]))), 2)
+
+        l2 = []
+        for i in range(0, 8, 2):
+            l2.extend(float(v) for v in np.asarray(s2(win(i)).numpy()))
+        assert l1 == l2
+        before = counters.snapshot()
+        s2(win(4)).numpy()
+        d = counters.delta(before)
+        assert d.get("jit.host.dispatches", 0) == 1
+        assert d.get("jit.steps", 0) == 2
+        assert d.get("jit.traces", 0) == 0
+
+    def test_gradscaler_on_mesh_skips_same_steps(self):
+        xs, ys = _data()
+        xs_bad = [x.copy() for x in xs]
+        xs_bad[2][0, 0] = np.inf
+
+        def run(mesh):
+            _, _, s = _make(
+                mesh=mesh,
+                scaler=paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10))
+            out = _run(s, xs_bad, ys)
+            s.sync()
+            return out
+
+        l0, l2 = run(None), run(_mesh(2, 1))
+        assert ([np.isfinite(v) for v in l0]
+                == [np.isfinite(v) for v in l2])
+        assert np.allclose([v for v in l0 if np.isfinite(v)],
+                           [v for v in l2 if np.isfinite(v)], rtol=1e-5)
+
+    def test_indivisible_batch_degrades_to_replicated(self):
+        # 5 rows on dp=2: the batch constraint must not apply (5 % 2 != 0)
+        # and the step still matches the single-device run
+        rng = np.random.RandomState(3)
+        x = rng.randn(5, 8).astype("float32")
+        y = rng.randn(5, 4).astype("float32")
+        _, _, s0 = _make()
+        l0 = float(s0(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+        _, _, s2 = _make(mesh=_mesh(2, 1))
+        l2 = float(s2(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+        assert np.allclose(l0, l2, rtol=1e-5, atol=1e-6)
+
+    def test_gpt_placements_auto_pickup(self):
+        # model-declared tensor-parallel placements (annotate_param) must
+        # shard the carry with NO shard_rules passed
+        from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                       GPTPretrainingCriterion)
+        mesh = _mesh(1, 2)
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=4, max_seq_len=16,
+                        use_flash_attention=False)
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+        step = pjit.CompiledTrainStep(
+            model, lambda m, x, l: crit(m(x), l), opt, mesh=mesh)
+        ids = paddle.randint(0, cfg.vocab_size, [2, 16])
+        labels = paddle.randint(0, cfg.vocab_size, [2, 16])
+        assert np.isfinite(float(step(ids, labels).numpy()))
+        mp_sharded = [k for k, v in step._state[0].items()
+                      if "mp" in str(getattr(v.sharding, "spec", P()))]
+        assert mp_sharded, "no parameter picked up an mp placement"
+
+
+class TestInferPartitionSpecs:
+    def _mesh22(self):
+        return _mesh(2, 2)
+
+    def test_first_matching_rule_wins(self):
+        from paddle_tpu.distributed.sharding_utils import (
+            infer_partition_specs)
+        mesh = self._mesh22()
+        tree = {"enc": {"weight": np.zeros((8, 16))},
+                "dec": {"weight": np.zeros((16, 8))}}
+        specs = infer_partition_specs(
+            tree, mesh,
+            [(r"enc/weight", P("mp", None)),
+             (r"weight", P(None, "mp"))])
+        assert specs["enc"]["weight"] == P("mp", None)
+        assert specs["dec"]["weight"] == P(None, "mp")
+
+    def test_unmatched_leaves_get_default(self):
+        from paddle_tpu.distributed.sharding_utils import (
+            infer_partition_specs)
+        mesh = self._mesh22()
+        tree = {"w": np.zeros((8, 8)), "b": np.zeros((8,))}
+        specs = infer_partition_specs(tree, mesh,
+                                      [(r"^w$", P("dp", None))])
+        assert specs["w"] == P("dp", None)
+        assert specs["b"] == P()
+        none_specs = infer_partition_specs(
+            tree, mesh, [(r"^w$", P("dp", None))], default=None)
+        assert none_specs["b"] is None
+
+    def test_unknown_axis_falls_back_replicated(self):
+        from paddle_tpu.distributed.sharding_utils import (
+            infer_partition_specs)
+        mesh = self._mesh22()
+        tree = {"w": np.zeros((8, 8))}
+        with pytest.warns(RuntimeWarning, match="not in"):
+            specs = infer_partition_specs(tree, mesh,
+                                          [(r"w", P("fsdp", None))])
+        assert specs["w"] == P()
+
+    def test_indivisible_dim_falls_back_replicated(self):
+        from paddle_tpu.distributed.sharding_utils import (
+            infer_partition_specs)
+        mesh = self._mesh22()
+        tree = {"w": np.zeros((7, 8))}  # 7 % dp=2 != 0
+        with pytest.warns(RuntimeWarning, match="not divisible"):
+            specs = infer_partition_specs(tree, mesh,
+                                          [(r"w", P("dp", None))])
+        assert specs["w"] == P()
+
+    def test_nested_paths_and_sequences(self):
+        from paddle_tpu.distributed.sharding_utils import (
+            infer_partition_specs)
+        mesh = self._mesh22()
+        tree = {"layers": [{"weight": np.zeros((4, 8))},
+                           {"weight": np.zeros((4, 8))}]}
+        specs = infer_partition_specs(
+            tree, mesh, [(r"layers/1/weight", P(None, "mp"))])
+        assert specs["layers"][0]["weight"] == P()
+        assert specs["layers"][1]["weight"] == P(None, "mp")
+
+
+class TestShardedPrefetchers:
+    def _loader(self, n=8, b=4):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        rng = np.random.RandomState(5)
+        ds = TensorDataset(
+            [paddle.to_tensor(rng.randn(n * b, 8).astype("float32")),
+             paddle.to_tensor(rng.randn(n * b, 4).astype("float32"))])
+        return DataLoader(ds, batch_size=b, shuffle=False)
+
+    def test_device_prefetcher_sharded_values_identical(self):
+        from paddle_tpu.io import DevicePrefetcher
+        mesh = _mesh(2, 1)
+        loader = self._loader()
+        plain = [[np.asarray(t.numpy()) for t in batch]
+                 for batch in loader]
+        before = counters.snapshot()
+        pref = DevicePrefetcher(loader,
+                                sharding=NamedSharding(mesh, P("dp")))
+        staged = list(pref)
+        d = counters.delta(before)
+        assert len(staged) == len(plain)
+        for got, want in zip(staged, plain):
+            for g, w in zip(got, want):
+                assert np.array_equal(np.asarray(g.numpy()), w)
+                # each leaf landed data-parallel in one sharded put
+                assert g._data.sharding.spec == P("dp")
+        assert d.get("dist.device_put_sharded_bytes", 0) > 0
+
+    def test_device_prefetcher_indivisible_leaf_replicates(self):
+        from paddle_tpu.io import DevicePrefetcher
+        mesh = _mesh(2, 1)
+        from paddle_tpu.io import DataLoader, TensorDataset
+        rng = np.random.RandomState(5)
+        ds = TensorDataset(
+            [paddle.to_tensor(rng.randn(9, 8).astype("float32"))])
+        loader = DataLoader(ds, batch_size=3, shuffle=False)  # 3 % 2 != 0
+        pref = DevicePrefetcher(loader,
+                                sharding=NamedSharding(mesh, P("dp")))
+        for (t,) in pref:
+            # degraded to replicated-on-mesh: uniform device set, no
+            # partial shards
+            assert t._data.sharding.spec == P()
+            assert len(t._data.sharding.device_set) == 2
+
+    def test_stacking_prefetcher_sharded_window(self):
+        from paddle_tpu.io import StackingPrefetcher
+        mesh = _mesh(2, 1)
+        loader = self._loader(n=4, b=4)
+        plain = [[np.asarray(t.numpy()) for t in batch]
+                 for batch in loader]
+        wins = list(StackingPrefetcher(
+            loader, k=2, sharding=NamedSharding(mesh, P("dp"))))
+        assert len(wins) == 2
+        for wi, w in enumerate(wins):
+            for leaf_i, leaf in enumerate(w):  # a Window IS the arg tuple
+                # window axis replicated, batch axis sharded — the xs
+                # layout the mesh-native fused step scans over
+                assert leaf._data.sharding.spec == P(None, "dp")
+                assert tuple(leaf._data.addressable_shards[0].data.shape
+                             )[:2] == (2, 2)
+                want = np.stack([plain[2 * wi + j][leaf_i]
+                                 for j in range(2)])
+                assert np.array_equal(np.asarray(leaf.numpy()), want)
