@@ -19,11 +19,12 @@ Legs (perf round 5):
   launch per K steps) — the reported ``fused_speedup`` is the
   dispatch-amortisation win on the leg most exposed to per-step python
   overhead.
-- gpt125m_serve (serving leg): 8 staggered mixed-length requests through
-  ``serving.LLMEngine`` (continuous batching over the KV slot arena) vs
-  the same requests run sequentially through ``GPT.generate`` — reports
-  decode tokens/s for both and ``serve_speedup``, and asserts the engine
-  output is token-identical to the sequential path.
+- gpt125m_serve (serving leg): 64 staggered mixed-length requests through
+  ``serving.LLMEngine`` (continuous batching over the KV slot arena),
+  with the first few verified token-identical against sequential
+  ``GPT.generate`` — reports decode tokens/s for both, ``serve_speedup``,
+  and TTFT / inter-token / queue-wait latency percentiles
+  (p50/p95/p99 in ms) from the engine's mergeable histograms.
 - gpt125m_fleet (elastic-fleet leg): the same seeded request set through
   a 2-replica ``serving.ServingFleet`` clean, then with one replica
   killed mid-decode (``faultinject`` ``replica_crash``) — reports decode
@@ -39,6 +40,12 @@ Legs (perf round 5):
   dispatches == steps/K on the mesh path, and ≥70% dp scaling efficiency
   on real chips (forced-host CPU "devices" share cores, so the scaling
   number is informational there).
+Every training leg embeds a compact "metrics" block (loss / grad-norm /
+tok/s / step-time / MFU stats from the zero-sync in-graph MetricsLogger
+accumulators); the serve and fleet legs embed TTFT / inter-token /
+queue-wait percentiles; the ckpt leg embeds save-latency percentiles;
+the mesh legs embed per-compiled-program HBM bytes ("hbm") captured via
+XLA memory analysis under FLAGS_device_telemetry.
 Set PTPU_BENCH=125m|760m|serve|ckpt|fleet|mesh|mesh760m to run a single
 leg.  PTPU_FUSED_STEPS sets the fused window length K (default 4; 1
 disables the fused leg).  PTPU_MESH picks the mesh leg's axis degrees.
@@ -49,6 +56,15 @@ import os
 import time
 
 import numpy as np
+
+
+def _metrics_summary(logger, keys=("loss", "grad_norm", "tok_s",
+                                  "step_time_s", "mfu")):
+    """Compact per-metric stats from a ``MetricsLogger`` for the leg JSON."""
+    if logger is None:
+        return {}
+    return {k: {f: round(float(x), 6) for f, x in s.items()}
+            for k, s in logger.summary().items() if k in keys}
 
 
 def _run_leg(cfg, batch, seq, iters, rounds, fused_steps=1):
@@ -67,7 +83,10 @@ def _run_leg(cfg, batch, seq, iters, rounds, fused_steps=1):
         return crit(m(x), l)
 
     k = max(1, int(fused_steps))
-    step = CompiledTrainStep(model, loss_fn, opt, fused_steps=k)
+    # metrics=True: in-graph telemetry rides the donated carry — the MFU
+    # this leg reports is also derivable from the harvested series
+    step = CompiledTrainStep(model, loss_fn, opt, fused_steps=k,
+                             metrics=True)
     if k > 1:
         win = Window(
             (paddle.to_tensor(np.stack([np.asarray(ids.numpy())] * k)),
@@ -106,8 +125,10 @@ def _run_leg(cfg, batch, seq, iters, rounds, fused_steps=1):
     phases = {"compile_s": round(compile_s, 4),
               "first_step_s": round(first_step_s, 4),
               "steady_step_s": round(batch * seq / tokens_per_sec, 6)}
+    step.metrics_flush()  # harvest pending device refs at the leg boundary
+    msum = _metrics_summary(step.metrics)
     del step, model, opt  # free HBM before the next leg
-    return tokens_per_sec, spread, n_params, phases
+    return tokens_per_sec, spread, n_params, phases, msum
 
 
 def _run_ckpt_leg(cfg, batch, seq, iters, fused_steps=1,
@@ -171,9 +192,13 @@ def _run_ckpt_leg(cfg, batch, seq, iters, fused_steps=1,
         ckpt_s = time.perf_counter() - t0
         delta = counters.delta(before)
 
+    from paddle_tpu.profiler import metrics as _pm
     saves = delta.get("resilience.saves", 0)
     tokens = batch * seq * k * n_windows
+    save_h = _pm.get_histogram("resilience.save_ms").summary()
     leg = {"fused_steps": k,
+           "save_ms_p50": round(save_h["p50"], 2),
+           "save_ms_p99": round(save_h["p99"], 2),
            "windows": n_windows,
            "async_saves": saves,
            "tokens_per_sec": round(tokens / max(ckpt_s, 1e-9), 2),
@@ -191,22 +216,38 @@ def _run_ckpt_leg(cfg, batch, seq, iters, fused_steps=1,
     return leg
 
 
-def _run_serve_leg(cfg, n_requests=8, max_new=64, max_slots=8,
-                   min_bucket=8, seed=0):
-    """Continuous-batching serving vs sequential generate on the same
-    staggered mixed-length request set.  Both paths are timed warm (all
-    programs compiled); the engine run is two waves so late arrivals
-    really do join slots mid-decode.  Returns the leg dict."""
+def _latency_ms(hist):
+    """Compact p50/p95/p99 (+count/mean) in ms from an ns histogram."""
+    s = hist.summary()
+    return {"count": s["count"],
+            "mean_ms": round(s["mean"] / 1e6, 3),
+            "p50_ms": round(s["p50"] / 1e6, 3),
+            "p95_ms": round(s["p95"] / 1e6, 3),
+            "p99_ms": round(s["p99"] / 1e6, 3)}
+
+
+def _run_serve_leg(cfg, n_requests=64, max_new=64, max_slots=8,
+                   min_bucket=8, n_verify=8, seed=0):
+    """Continuous-batching serving vs sequential generate.  The engine
+    serves ``n_requests`` staggered mixed-length requests (its TTFT /
+    inter-token-latency / queue-wait histograms give the leg's p50/p95/p99
+    tail); the first ``n_verify`` of them are also run through sequential
+    ``GPT.generate`` for the token-identity gate and the speedup baseline.
+    Both paths are timed warm (one warm engine request per distinct
+    prefill bucket); the engine run is two waves so late arrivals really
+    do join slots mid-decode.  Returns the leg dict."""
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTForCausalLM
     from paddle_tpu.profiler import counters
     from paddle_tpu.serving import LLMEngine
+    from paddle_tpu.serving.engine import bucket_length
 
     paddle.seed(seed)
     model = GPTForCausalLM(cfg)
     model.eval()
     rng = np.random.RandomState(seed)
     S = cfg.max_seq_len
+    n_verify = min(n_verify, n_requests)
     lens = [int(rng.randint(max(2, S // 16), S - max_new))
             for _ in range(n_requests)]
     prompts = [rng.randint(0, cfg.vocab_size, size=n).tolist()
@@ -215,7 +256,8 @@ def _run_serve_leg(cfg, n_requests=8, max_new=64, max_slots=8,
     def seq_pass():
         return [np.asarray(model.generate(
             paddle.to_tensor(np.asarray([p])),
-            max_new_tokens=max_new).numpy())[0] for p in prompts]
+            max_new_tokens=max_new).numpy())[0]
+            for p in prompts[:n_verify]]
     seq_pass()  # warm: one compiled generate program per prompt length
     t0 = time.perf_counter()
     seq_outs = seq_pass()
@@ -223,8 +265,15 @@ def _run_serve_leg(cfg, n_requests=8, max_new=64, max_slots=8,
 
     eng = LLMEngine(model, max_slots=max_slots, max_seq_len=S,
                     min_bucket=min_bucket)
-    for _ in eng.generate(prompts, max_new_tokens=max_new):
-        pass  # warm: bucketed prefill/insert programs + decode program
+    # warm: one throwaway request per distinct prefill bucket (compiles
+    # prefill + insert) plus the decode program
+    warm = [rng.randint(0, cfg.vocab_size,
+                        size=min(b, S - 3)).tolist()
+            for b in sorted({bucket_length(n, min_bucket, S)
+                             for n in lens})]
+    for _ in eng.generate(warm, max_new_tokens=2):
+        pass
+    warmed_counts = {n: h.count for n, h in eng.hists.items()}
     before = counters.snapshot()
     t0 = time.perf_counter()
     half = n_requests // 2
@@ -240,20 +289,29 @@ def _run_serve_leg(cfg, n_requests=8, max_new=64, max_slots=8,
     delta = counters.delta(before)
 
     match = all(np.array_equal(h.output_ids(), s)
-                for h, s in zip(hs, seq_outs))
-    decode_tokens = n_requests * max_new
-    serve_tps = decode_tokens / max(serve_s, 1e-9)
-    seq_tps = decode_tokens / max(seq_s, 1e-9)
+                for h, s in zip(hs[:n_verify], seq_outs))
+    serve_tps = n_requests * max_new / max(serve_s, 1e-9)
+    seq_tps = n_verify * max_new / max(seq_s, 1e-9)
+    snap = eng.histogram_snapshot()
     leg = {"requests": n_requests,
            "max_new_tokens": max_new,
            "max_slots": max_slots,
-           "prompt_lens": lens,
            "decode_tokens_per_sec": round(serve_tps, 2),
            "sequential_tokens_per_sec": round(seq_tps, 2),
            "serve_speedup": round(serve_tps / max(seq_tps, 1e-9), 4),
            "outputs_match_generate": match,
            "steady_retraces": delta.get("serving.retraces", 0),
-           "prefill_programs": eng.stats()["prefill_programs"]}
+           "prefill_programs": eng.stats()["prefill_programs"],
+           "ttft": _latency_ms(snap["serving.ttft_ns"]),
+           "itl": _latency_ms(snap["serving.itl_ns"]),
+           "queue_wait": _latency_ms(snap["serving.queue_wait_ns"])}
+    # the tail stats must cover the measured request set, not just warmup
+    measured = snap["serving.ttft_ns"].count \
+        - warmed_counts["serving.ttft_ns"]
+    if measured < n_requests:
+        raise AssertionError(
+            f"serving leg: TTFT histogram covered {measured} measured "
+            f"requests, expected {n_requests}")
     if not match:
         raise AssertionError(
             "serving leg: engine output diverged from sequential "
@@ -310,6 +368,9 @@ def _run_fleet_leg(cfg, replicas=2, n_requests=8, max_new=32, max_slots=4,
     run_pass()  # warm timing pass (programs already compiled at spawn)
     clean_hs, clean_s, clean_d = run_pass()
     churn_hs, churn_s, churn_d = run_pass(kill=True)
+    # fleet-wide latency tail: replica histograms merged by the router
+    # (dead replicas included — their delivered latency counts)
+    agg = fleet.router.aggregate_histograms(fleet._replicas)
     fleet.drain()
 
     match = all(c.finish_reason == "length" and k.finish_reason == "length"
@@ -330,7 +391,10 @@ def _run_fleet_leg(cfg, replicas=2, n_requests=8, max_new=32, max_slots=4,
            "replayed_tokens": churn_d.get("serving.fleet.replayed_tokens",
                                           0),
            "steady_retraces": clean_d.get("serving.retraces", 0),
-           "outputs_match_clean": match}
+           "outputs_match_clean": match,
+           "ttft": _latency_ms(agg["serving.ttft_ns"]),
+           "itl": _latency_ms(agg["serving.itl_ns"]),
+           "queue_wait": _latency_ms(agg["serving.queue_wait_ns"])}
     if (not match or leg["lost"] != 0 or leg["respawns"] != 1
             or leg["retried"] < 1 or leg["steady_retraces"] != 0):
         raise AssertionError(
@@ -364,10 +428,12 @@ def _run_mesh_leg(cfg, batch_per_chip, seq, iters, rounds, degrees,
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     import paddle_tpu as paddle
+    from paddle_tpu.core import flags as _flags
     from paddle_tpu.io import Window
     from paddle_tpu.jit import CompiledTrainStep
     from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
     from paddle_tpu.profiler import counters
+    from paddle_tpu.profiler import metrics as _pm
 
     k = max(1, int(fused_steps))
 
@@ -458,7 +524,18 @@ def _run_mesh_leg(cfg, batch_per_chip, seq, iters, rounds, degrees,
 
     base_tps, _, _, base_compile_s, _ = one(
         {a: 1 for a in degrees})
-    tps, ndev, n_params, compile_s, steady = one(degrees)
+    # device telemetry ON for the mesh pass: per-program HBM bytes (XLA
+    # memory analysis at the compile site) land in program_stats; the AOT
+    # lower happens at warmup, so the steady-state gate is unaffected
+    _flags.set_flags({"FLAGS_device_telemetry": True})
+    try:
+        tps, ndev, n_params, compile_s, steady = one(degrees)
+    finally:
+        _flags.set_flags({"FLAGS_device_telemetry": False})
+    hbm = {name: {f: st.get(f) for f in
+                  ("arg_bytes", "out_bytes", "temp_bytes", "compile_s")}
+           for name, st in _pm.program_stats().items()
+           if name.startswith("jit.")}
     tps_chip = tps / ndev
     eff = tps_chip / base_tps
     leg = {"mesh": dict(degrees),
@@ -472,7 +549,8 @@ def _run_mesh_leg(cfg, batch_per_chip, seq, iters, rounds, degrees,
            "mfu": round(tps_chip * 6 * n_params / peak, 4),
            "compile_s": compile_s,
            "single_chip_compile_s": base_compile_s,
-           "steady": steady}
+           "steady": steady,
+           "hbm": hbm}
     if min_scaling is not None and eff < min_scaling:
         raise AssertionError(
             f"mesh leg scaling efficiency {eff:.3f} below the "
@@ -512,22 +590,24 @@ def main():
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=128,
                         use_flash_attention=False)
-        tps, spread, _, phases = _run_leg(cfg, 2, 128, 4, 1)
+        tps, spread, _, phases, msum = _run_leg(cfg, 2, 128, 4, 1)
         out = {"metric": "gpt_tiny_cpu_tokens_per_sec",
                "value": round(tps, 2), "unit": "tokens/s",
                "vs_baseline": 0.0,
                "spread_frac": round(spread, 4),
-               "phases": phases}
+               "phases": phases,
+               "metrics": msum}
         if fused_k > 1:
-            ftps, _, _, fphases = _run_leg(cfg, 2, 128, 4, 1,
-                                           fused_steps=fused_k)
+            ftps, _, _, fphases, fmsum = _run_leg(cfg, 2, 128, 4, 1,
+                                                  fused_steps=fused_k)
             out["fused"] = {"fused_steps": fused_k,
                             "tokens_per_sec": round(ftps, 2),
                             "fused_speedup": round(ftps / tps, 4),
-                            "phases": fphases}
+                            "phases": fphases,
+                            "metrics": fmsum}
         # tiny serving leg: correctness gate (token identity) always; the
         # speedup number is informational on CPU
-        out["serve"] = _run_serve_leg(cfg, n_requests=8, max_new=8,
+        out["serve"] = _run_serve_leg(cfg, n_requests=64, max_new=8,
                                       max_slots=4, min_bucket=4)
         # tiny checkpoint leg: async-save overlap + one-sync-per-save
         # budget (overhead number is informational on CPU)
@@ -565,34 +645,37 @@ def main():
                                   recompute="selective_lean")
         # rounds=4: the first post-compile round can run ~3% cold (seen in
         # r5 combined runs); the median over 4 shakes it off
-        tps, spread, n, phases = _run_leg(cfg, 8, 1024, 10, 4)
+        tps, spread, n, phases, msum = _run_leg(cfg, 8, 1024, 10, 4)
         legs["gpt760m"] = {"tokens_per_sec": round(tps, 2),
                            "mfu": round(tps * 6 * n / peak, 4),
                            "spread_frac": round(spread, 4),
-                           "phases": phases}
+                           "phases": phases,
+                           "metrics": msum}
     if which in ("all", "125m"):
         cfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
                                   dtype="bfloat16",
                                   use_flash_attention=True,
                                   recompute="selective")
-        tps, spread, n, phases = _run_leg(cfg, 16, 1024, 15, 3)
+        tps, spread, n, phases, msum = _run_leg(cfg, 16, 1024, 15, 3)
         legs["gpt125m"] = {"tokens_per_sec": round(tps, 2),
                            "mfu": round(tps * 6 * n / peak, 4),
                            "spread_frac": round(spread, 4),
-                           "phases": phases}
+                           "phases": phases,
+                           "metrics": msum}
         if fused_k > 1:
             # fused-dispatch leg: same model/config, K steps per XLA
             # launch — isolates the per-step python dispatch overhead
             # that the 125m leg is most exposed to
-            ftps, fspread, n, fphases = _run_leg(cfg, 16, 1024, 16, 3,
-                                                 fused_steps=fused_k)
+            ftps, fspread, n, fphases, fmsum = _run_leg(
+                cfg, 16, 1024, 16, 3, fused_steps=fused_k)
             legs["gpt125m_fused"] = {
                 "fused_steps": fused_k,
                 "tokens_per_sec": round(ftps, 2),
                 "mfu": round(ftps * 6 * n / peak, 4),
                 "fused_speedup": round(ftps / tps, 4),
                 "spread_frac": round(fspread, 4),
-                "phases": fphases}
+                "phases": fphases,
+                "metrics": fmsum}
     if which in ("all", "ckpt"):
         # checkpointed-training leg: steady fused windows with async saves
         # overlapping the next window — reports ckpt_overhead_frac and
@@ -604,14 +687,15 @@ def main():
         legs["gpt125m_ckpt"] = _run_ckpt_leg(ccfg, 16, 1024, 16,
                                              fused_steps=max(1, fused_k))
     if which in ("all", "serve"):
-        # serving leg: continuous batching vs sequential generate on 8
-        # staggered mixed-length requests (acceptance: serve_speedup > 1
-        # on TPU, outputs token-identical always)
+        # serving leg: continuous batching over 64 staggered mixed-length
+        # requests with TTFT/ITL/queue-wait percentiles (acceptance:
+        # serve_speedup > 1 on TPU, verified prefix token-identical to
+        # sequential generate always)
         scfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
                                    dtype="bfloat16",
                                    use_flash_attention=False,
                                    recompute=None)
-        legs["gpt125m_serve"] = _run_serve_leg(scfg, n_requests=8,
+        legs["gpt125m_serve"] = _run_serve_leg(scfg, n_requests=64,
                                                max_new=64, max_slots=8)
     if which in ("all", "fleet"):
         # elastic-fleet leg: multi-replica throughput with and without

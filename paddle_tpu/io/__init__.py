@@ -23,6 +23,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..profiler import counters as _counters
 from ..profiler import host_tracer as _trace
+from ..profiler import metrics as _metrics
 
 
 class Dataset:
@@ -374,8 +375,9 @@ class _PrefetchIter:
                     self._next_emit += 1
                     self._cv.notify_all()  # wake backpressured workers
                     # time this consumer spent blocked on the worker queue
-                    _counters.inc("io.queue_wait_ns",
-                                  _time.perf_counter_ns() - t0)
+                    _metrics.observe("io.queue_wait_ns",
+                                     _time.perf_counter_ns() - t0,
+                                     unit="ns", sum_counter=True)
                     if isinstance(batch, Exception):
                         raise batch
                     return batch
@@ -566,7 +568,8 @@ class DevicePrefetcher:
                 # dry — otherwise the transfer already in flight hides it
                 _counters.inc("io.reader_ns", wait)
                 if not buf:
-                    _counters.inc("io.prefetch_stall_ns", wait)
+                    _metrics.observe("io.prefetch_stall_ns", wait,
+                                     unit="ns", sum_counter=True)
                 with _trace.span("io.device_put"):
                     staged = self._stage(batch)
                 buf.append(staged)

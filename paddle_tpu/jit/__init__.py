@@ -27,7 +27,9 @@ from ..core import flags as _flags
 from ..core.state import STATE, no_grad_guard
 from ..core.tensor import Parameter, Tensor
 from ..profiler import counters as _counters
+from ..profiler import flight as _flight
 from ..profiler import host_tracer as _trace
+from ..profiler import metrics as _metrics
 
 
 def _is_layer(obj):
@@ -302,6 +304,16 @@ class CompiledTrainStep:
         K single-step dispatches — no batch is dropped or padded;
       * ``.sync()`` and the mutation barrier land on post-window values.
 
+    Telemetry: ``metrics=MetricsLogger(...)`` (profiler.metrics) records
+    per-step loss / grad global-norm / lr / scaler scale+skip / step-time /
+    tok/s / MFU.  The device-derived scalars are traced into the step
+    program and accumulated in a donated on-device accumulator (part of
+    the fused-window scan carry); the host harvests them only at existing
+    sync boundaries (``sync()``, checkpoint export, or an explicit
+    ``metrics_flush()``) — steady-state counter gates (0 retraces /
+    hydrates / binds, dispatches == steps/K) hold with metrics ON, which
+    ``scripts/check_counters.py`` enforces.
+
     With ``scaler`` (an enabled amp.GradScaler), fp16 dynamic loss scaling
     runs in-graph: scaled backward, traced found-inf, skipped update, scale
     adjustment — zero host round-trips (reference: amp/grad_scaler.py:619).
@@ -330,11 +342,25 @@ class CompiledTrainStep:
 
     def __init__(self, model, loss_fn, optimizer, scaler=None, donate=True,
                  fused_steps=None, mesh=None, shard_rules=None,
-                 batch_axes=None):
+                 batch_axes=None, metrics=None):
         import weakref
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # per-step train telemetry (profiler.metrics.MetricsLogger): the
+        # device-derived scalars (loss / grad global-norm / scaler state)
+        # accumulate INSIDE the donated carry and per-dispatch lazy refs,
+        # harvested only at sync boundaries — metrics ON adds zero
+        # syncs/retraces/dispatches (gated in scripts/check_counters.py)
+        self.metrics = (_metrics.MetricsLogger() if metrics is True
+                        else metrics)
+        self._macc = None            # donated device metric accumulator
+        self._pending = []           # un-harvested per-dispatch metric refs
+        self._pending_cap = 512      # auto-harvest backstop
+        self._last_dispatch_t = None
+        self._tokens_per_step = None
+        self._tok_cached = False
+        self._n_params = None
         self.scaler = scaler if (scaler is not None
                                  and scaler.is_enable()) else None
         if fused_steps is None:
@@ -516,7 +542,11 @@ class CompiledTrainStep:
 
     def sync(self):
         """Flush the device-resident state back into the python
-        model/optimizer/scaler objects (pointer rebinds, no host transfer)."""
+        model/optimizer/scaler objects (pointer rebinds, no host transfer).
+        An existing sync boundary is also where pending train metrics are
+        harvested into the MetricsLogger (no extra ``jit.syncs``)."""
+        if self.metrics is not None:
+            self.metrics_flush()
         if self._state is None or self._synced:
             return
         with _trace.span("jit.sync"):
@@ -565,13 +595,21 @@ class CompiledTrainStep:
             self._state = (params, buffers, opt_state, sstate, key)
         self._lr_host = self._lr_dev = None
         self._lrs_host = self._lrs_dev = None
+        # the restored run starts a fresh metric accumulator; un-harvested
+        # refs from the faulted timeline are dropped (the flight recorder
+        # already captured them at dump time)
+        self._macc = None
+        self._pending = []
+        self._last_dispatch_t = None
 
-    def _step_body(self, check_nan_inf, params, buffers, opt_state, lr,
-                   rng_key, sstate, args):
+    def _step_body(self, check_nan_inf, metrics_on, params, buffers,
+                   opt_state, lr, rng_key, sstate, args):
         """One training step as a pure traceable function — the body shared
         by the single-step program and each ``lax.scan`` iteration of a
         fused window.  Returns (loss, params', buffers', opt_state',
-        sstate', rng_carry', checks)."""
+        sstate', rng_carry', checks, mets); ``mets`` carries the traced
+        per-step telemetry scalars (grad global-norm, scaler scale/skip)
+        when ``metrics_on``, else is empty."""
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
         scaler = self.scaler
         from ..tensor import random as _rnd
@@ -620,6 +658,17 @@ class CompiledTrainStep:
                     if p.grad is not None:
                         checks["grad:" + k] = jnp.all(jnp.isfinite(
                             p.grad._data.astype(jnp.float32)))
+            mets = {}
+            if metrics_on:
+                # grad global-norm over the (post-unscale) grads the
+                # optimizer is about to consume — traced into the program,
+                # so metrics-on costs one fused reduction, zero host work
+                sq = jnp.zeros((), jnp.float32)
+                for _, p in model.named_parameters():
+                    if p.grad is not None:
+                        g32 = p.grad._data.astype(jnp.float32)
+                        sq = sq + jnp.sum(g32 * g32)
+                mets["grad_norm"] = jnp.sqrt(sq)
             opt.step()
             opt.clear_grad()
             new_params = {k: p._data for k, p in model.named_parameters()}
@@ -638,6 +687,14 @@ class CompiledTrainStep:
                         v.astype(jnp.float32)))
                 if scaler is not None:
                     checks["found_inf"] = found
+            if metrics_on:
+                if scaler is not None:
+                    mets["skip"] = found.astype(jnp.float32)
+                    mets["scale"] = jnp.reshape(jnp.asarray(
+                        sstate["scale"], jnp.float32), (-1,))[0]
+                else:
+                    mets["skip"] = jnp.zeros((), jnp.float32)
+                    mets["scale"] = jnp.ones((), jnp.float32)
             loss_data = loss._data
         finally:
             STATE.tracing_depth -= 1
@@ -655,7 +712,7 @@ class CompiledTrainStep:
             opt._accumulators = saved_accs
             opt._master_weights = saved_masters
         return (loss_data, new_params, new_buffers, new_opt, sstate,
-                carry_key, checks)
+                carry_key, checks, mets)
 
     def _donate_argnums(self):
         # full donation including the scaler path: _skip_select consumes
@@ -663,41 +720,101 @@ class CompiledTrainStep:
         # buffers/opt-state buffers to the outputs is still legal
         return (0, 1, 2) if self._donate else ()
 
-    def _make_jit(self, check_nan_inf=False):
-        def step_fn(params, buffers, opt_state, lr, rng_key, sstate, args):
-            return self._step_body(check_nan_inf, params, buffers, opt_state,
-                                   lr, rng_key, sstate, args)
+    _MACC_KEYS = ("steps", "loss_sum", "grad_norm_sum", "skip_sum")
 
-        return jax.jit(step_fn, donate_argnums=self._donate_argnums())
+    def _macc_add(self, macc, loss, mets):
+        """Fold one step's traced scalars into the donated metric
+        accumulator (running totals ride the carry; harvested at sync
+        boundaries by :meth:`metrics_flush`)."""
+        loss32 = jnp.mean(loss.astype(jnp.float32))
+        out = {"steps": macc["steps"] + 1.0,
+               "loss_sum": macc["loss_sum"] + loss32,
+               "grad_norm_sum": macc["grad_norm_sum"] + mets["grad_norm"],
+               "skip_sum": macc["skip_sum"] + mets["skip"]}
+        if self.mesh is not None:
+            out = {k: self._pin(v, None) for k, v in out.items()}
+        return out
 
-    def _make_fused_jit(self, check_nan_inf, k):
+    def _make_jit(self, check_nan_inf=False, metrics_on=False):
+        if not metrics_on:
+            def step_fn(params, buffers, opt_state, lr, rng_key, sstate,
+                        args):
+                return self._step_body(check_nan_inf, False, params, buffers,
+                                       opt_state, lr, rng_key, sstate,
+                                       args)[:7]
+
+            return jax.jit(step_fn, donate_argnums=self._donate_argnums())
+
+        def step_fn(params, buffers, opt_state, lr, rng_key, sstate, args,
+                    macc):
+            (loss, params, buffers, opt_state, sstate, rng_key, checks,
+             mets) = self._step_body(check_nan_inf, True, params, buffers,
+                                     opt_state, lr, rng_key, sstate, args)
+            return (loss, params, buffers, opt_state, sstate, rng_key,
+                    checks, self._macc_add(macc, loss, mets), mets)
+
+        donate = self._donate_argnums()
+        return jax.jit(step_fn,
+                       donate_argnums=donate + (7,) if donate else ())
+
+    def _make_fused_jit(self, check_nan_inf, k, metrics_on=False):
         """Fused window program: ``jax.lax.scan`` of the single-step body
         over K stacked batches and a K-vector of learning rates — forward +
         backward + optimizer update for all K steps in ONE donated XLA
         launch.  Requires the optimizer accumulators to already exist (the
         scan carry structure must be invariant), so the first-ever window
-        runs through the single-step fallback instead."""
+        runs through the single-step fallback instead.  With metrics on,
+        the metric accumulator joins the scan carry and the per-step
+        telemetry scalars come back stacked as extra ys."""
+
+        if not metrics_on:
+            def window_fn(params, buffers, opt_state, lrs, rng_key, sstate,
+                          stacked_args):
+                def body(carry, xs):
+                    params, buffers, opt_state, sstate, rng_key = carry
+                    lr, args = xs
+                    (loss, params, buffers, opt_state, sstate, rng_key,
+                     checks, _) = self._step_body(check_nan_inf, False,
+                                                  params, buffers, opt_state,
+                                                  lr, rng_key, sstate, args)
+                    return ((params, buffers, opt_state, sstate, rng_key),
+                            (loss, checks))
+
+                init = (params, buffers, opt_state, sstate, rng_key)
+                ((params, buffers, opt_state, sstate, rng_key),
+                 (losses, checks)) = jax.lax.scan(body, init,
+                                                  (lrs, stacked_args),
+                                                  length=k)
+                return (losses, params, buffers, opt_state, sstate, rng_key,
+                        checks)
+
+            return jax.jit(window_fn,
+                           donate_argnums=self._donate_argnums())
 
         def window_fn(params, buffers, opt_state, lrs, rng_key, sstate,
-                      stacked_args):
+                      stacked_args, macc):
             def body(carry, xs):
-                params, buffers, opt_state, sstate, rng_key = carry
+                params, buffers, opt_state, sstate, rng_key, macc = carry
                 lr, args = xs
                 (loss, params, buffers, opt_state, sstate, rng_key,
-                 checks) = self._step_body(check_nan_inf, params, buffers,
-                                           opt_state, lr, rng_key, sstate,
-                                           args)
-                return ((params, buffers, opt_state, sstate, rng_key),
-                        (loss, checks))
+                 checks, mets) = self._step_body(check_nan_inf, True,
+                                                 params, buffers, opt_state,
+                                                 lr, rng_key, sstate, args)
+                macc = self._macc_add(macc, loss, mets)
+                return ((params, buffers, opt_state, sstate, rng_key, macc),
+                        (loss, checks, mets))
 
-            init = (params, buffers, opt_state, sstate, rng_key)
-            ((params, buffers, opt_state, sstate, rng_key),
-             (losses, checks)) = jax.lax.scan(body, init,
-                                              (lrs, stacked_args), length=k)
+            init = (params, buffers, opt_state, sstate, rng_key, macc)
+            ((params, buffers, opt_state, sstate, rng_key, macc),
+             (losses, checks, mets)) = jax.lax.scan(body, init,
+                                                    (lrs, stacked_args),
+                                                    length=k)
             return (losses, params, buffers, opt_state, sstate, rng_key,
-                    checks)
+                    checks, macc, mets)
 
-        return jax.jit(window_fn, donate_argnums=self._donate_argnums())
+        donate = self._donate_argnums()
+        return jax.jit(window_fn,
+                       donate_argnums=donate + (7,) if donate else ())
 
     def __call__(self, *args):
         with _trace.span("jit.step"):
@@ -782,13 +899,23 @@ class CompiledTrainStep:
         heartbeat()  # no-op unless under the elastic launcher
         return Tensor._wrap(losses)
 
+    def _ensure_macc(self):
+        if self._macc is None:
+            z = {k: jnp.zeros((), jnp.float32) for k in self._MACC_KEYS}
+            if self.mesh is not None:
+                z = jax.device_put(z, self._rep)
+            self._macc = z
+
     def _dispatch_single(self, args_data, lr_val):
         """One single-step XLA dispatch on raw array args -> raw loss."""
         _counters.inc("jit.steps")
         check = bool(_flags.flag("FLAGS_check_nan_inf"))
-        jit_fn = self._jits.get(check)
-        if jit_fn is None:
-            jit_fn = self._jits[check] = self._make_jit(check)
+        mon = self.metrics is not None
+        key = (check, True) if mon else check
+        jit_fn = self._jits.get(key)
+        fresh = jit_fn is None
+        if fresh:
+            jit_fn = self._jits[key] = self._make_jit(check, mon)
         if self._lr_dev is None or lr_val != self._lr_host:
             self._lr_host = lr_val
             self._lr_dev = jnp.asarray(lr_val, jnp.float32)
@@ -797,14 +924,31 @@ class CompiledTrainStep:
                 # single-device lr scalar would make the dispatch mix
                 # device sets — replicate it once per scheduler value
                 self._lr_dev = jax.device_put(self._lr_dev, self._rep)
+        if mon:
+            self._ensure_macc()
         params, buffers, opt_state, sstate, rng_key = self._state
+        if fresh and _metrics.device_telemetry_enabled():
+            cargs = (params, buffers, opt_state, self._lr_dev, rng_key,
+                     sstate, args_data) + ((self._macc,) if mon else ())
+            _metrics.capture_program_stats(
+                f"jit.step[check={int(check)},metrics={int(mon)}]",
+                jit_fn, *cargs)
         traces_before = _counters.get("jit.traces")
         with _trace.span("jit.dispatch"):
             _counters.inc("jit.host.dispatches")
-            (loss, new_params, new_buffers, new_opt, new_sstate,
-             new_rng, checks) = jit_fn(params, buffers, opt_state,
-                                       self._lr_dev, rng_key, sstate,
-                                       args_data)
+            _flight.record("jit.dispatch",
+                           step=self.optimizer._step_count + 1, k=1)
+            if mon:
+                (loss, new_params, new_buffers, new_opt, new_sstate,
+                 new_rng, checks, new_macc, mets) = jit_fn(
+                     params, buffers, opt_state, self._lr_dev, rng_key,
+                     sstate, args_data, self._macc)
+                self._macc = new_macc
+            else:
+                (loss, new_params, new_buffers, new_opt, new_sstate,
+                 new_rng, checks) = jit_fn(params, buffers, opt_state,
+                                           self._lr_dev, rng_key, sstate,
+                                           args_data)
         _counters.inc("jit.cache_hits"
                       if _counters.get("jit.traces") == traces_before
                       else "jit.cache_misses")
@@ -813,6 +957,9 @@ class CompiledTrainStep:
         self.optimizer._step_count += 1
         self._state = (new_params, new_buffers, new_opt, new_sstate, new_rng)
         self._synced = False
+        if mon:
+            self._note_metrics(loss, mets, (lr_val,), 1, args_data,
+                               stacked=False)
         if check and checks:
             self._raise_if_nonfinite(checks)
         return loss
@@ -823,34 +970,153 @@ class CompiledTrainStep:
         _counters.inc("jit.steps", k)
         _counters.inc("jit.fused_windows")
         check = bool(_flags.flag("FLAGS_check_nan_inf"))
-        cache_key = (check, k)
+        mon = self.metrics is not None
+        cache_key = (check, k, True) if mon else (check, k)
         jit_fn = self._fused_jits.get(cache_key)
-        if jit_fn is None:
+        fresh = jit_fn is None
+        if fresh:
             jit_fn = self._fused_jits[cache_key] = \
-                self._make_fused_jit(check, k)
+                self._make_fused_jit(check, k, mon)
         lrs_t = tuple(float(v) for v in lrs)
         if self._lrs_dev is None or lrs_t != self._lrs_host:
             self._lrs_host = lrs_t
             self._lrs_dev = jnp.asarray(lrs_t, jnp.float32)
             if self.mesh is not None:
                 self._lrs_dev = jax.device_put(self._lrs_dev, self._rep)
+        if mon:
+            self._ensure_macc()
         params, buffers, opt_state, sstate, rng_key = self._state
+        if fresh and _metrics.device_telemetry_enabled():
+            cargs = (params, buffers, opt_state, self._lrs_dev, rng_key,
+                     sstate, args_data) + ((self._macc,) if mon else ())
+            _metrics.capture_program_stats(
+                f"jit.window[check={int(check)},k={k},metrics={int(mon)}]",
+                jit_fn, *cargs)
         traces_before = _counters.get("jit.traces")
         with _trace.span("jit.dispatch"):
             _counters.inc("jit.host.dispatches")
-            (losses, new_params, new_buffers, new_opt, new_sstate,
-             new_rng, checks) = jit_fn(params, buffers, opt_state,
-                                       self._lrs_dev, rng_key, sstate,
-                                       args_data)
+            _flight.record("jit.dispatch",
+                           step=self.optimizer._step_count + k, k=k)
+            if mon:
+                (losses, new_params, new_buffers, new_opt, new_sstate,
+                 new_rng, checks, new_macc, mets) = jit_fn(
+                     params, buffers, opt_state, self._lrs_dev, rng_key,
+                     sstate, args_data, self._macc)
+                self._macc = new_macc
+            else:
+                (losses, new_params, new_buffers, new_opt, new_sstate,
+                 new_rng, checks) = jit_fn(params, buffers, opt_state,
+                                           self._lrs_dev, rng_key, sstate,
+                                           args_data)
         _counters.inc("jit.cache_hits"
                       if _counters.get("jit.traces") == traces_before
                       else "jit.cache_misses")
         self.optimizer._step_count += k
         self._state = (new_params, new_buffers, new_opt, new_sstate, new_rng)
         self._synced = False
+        if mon:
+            self._note_metrics(losses, mets, lrs_t, k, args_data,
+                               stacked=True)
         if check and checks:
             self._raise_if_nonfinite(checks, window=k)
         return losses
+
+    # -- train-metrics harvest (profiler.metrics) ----------------------------
+    def _infer_tokens(self, args_data, stacked):
+        """Tokens per training step from the batch shape: B*S of the first
+        >=2-D array leaf (ids [B, S]), else the leading batch size; with a
+        K-stacked window the leading window axis is skipped."""
+        skip = 1 if stacked else 0
+        for leaf in jax.tree_util.tree_leaves(args_data):
+            shape = getattr(leaf, "shape", None)
+            if shape is None or len(shape) <= skip:
+                continue
+            dims = shape[skip:]
+            if len(dims) >= 2:
+                return int(dims[0]) * int(dims[1])
+            return int(dims[0])
+        return None
+
+    def _count_params(self):
+        if self._n_params is None:
+            import math
+            self._n_params = sum(
+                int(math.prod(p._data.shape))
+                for _, p in self.model.named_parameters())
+        return self._n_params
+
+    def _note_metrics(self, loss, mets, lrs, k, args_data, stacked):
+        """Queue one dispatch's lazy metric refs (device arrays — NOT read
+        here) plus host-side context; :meth:`metrics_flush` materializes
+        them at the next sync boundary."""
+        import time
+        if not self._tok_cached:
+            self._tokens_per_step = self._infer_tokens(args_data, stacked)
+            self._tok_cached = True
+        now = time.perf_counter()
+        dt = (now - self._last_dispatch_t
+              if self._last_dispatch_t is not None else None)
+        self._last_dispatch_t = now
+        self._pending.append({
+            "gstep0": self.optimizer._step_count - k + 1, "k": k,
+            "loss": loss, "mets": mets, "lrs": lrs, "dt": dt,
+            "tokens": self._tokens_per_step,
+        })
+        if len(self._pending) >= self._pending_cap:
+            # backstop for loops that never hit a sync boundary: one host
+            # readback of tiny scalars (no jit.syncs, no state rebind)
+            self.metrics_flush()
+
+    def metrics_flush(self):
+        """Harvest pending per-step metrics into the MetricsLogger: one
+        host readback of the queued scalar refs + the donated accumulator.
+        Runs automatically at every existing sync boundary (``sync()``,
+        ``export_resume_state()``) — never adds a ``jit.syncs`` tick or an
+        extra dispatch."""
+        if self.metrics is None or (not self._pending
+                                    and self._macc is None):
+            return
+        import numpy as np
+        pending, self._pending = self._pending, []
+        peak_tflops = float(_flags.flag("FLAGS_peak_tflops") or 0.0)
+        n_params = self._count_params()
+        for rec in pending:
+            k = rec["k"]
+            loss = np.atleast_1d(np.asarray(rec["loss"], np.float64))
+            mvals = {name: np.atleast_1d(np.asarray(v, np.float64))
+                     for name, v in rec["mets"].items()}
+            step_time = rec["dt"] / k if rec["dt"] is not None else None
+            tokens = rec["tokens"]
+            tok_s = (tokens / step_time
+                     if tokens and step_time and step_time > 0 else None)
+            mfu = (6.0 * n_params * tok_s / (peak_tflops * 1e12)
+                   if tok_s and n_params and peak_tflops > 0 else None)
+            for i in range(k):
+                gstep = rec["gstep0"] + i
+
+                def _at(a):
+                    return float(a[i] if a.size > 1 else a[0])
+
+                self.metrics.log(
+                    step=gstep, loss=_at(loss),
+                    grad_norm=_at(mvals["grad_norm"]),
+                    lr=float(rec["lrs"][i if len(rec["lrs"]) > 1 else 0]),
+                    scaler_scale=_at(mvals["scale"]),
+                    scaler_skip=_at(mvals["skip"]),
+                    step_time_s=step_time, tok_s=tok_s, mfu=mfu)
+            _flight.record_point("loss", float(loss[-1]),
+                                 step=rec["gstep0"] + k - 1)
+        if self._macc is not None:
+            acc = {name: float(np.asarray(v))
+                   for name, v in self._macc.items()}
+            steps = acc["steps"]
+            if steps > 0:
+                _counters.set_gauge("train.steps_accum", steps)
+                _counters.set_gauge("train.loss_mean",
+                                    acc["loss_sum"] / steps)
+                _counters.set_gauge("train.grad_norm_mean",
+                                    acc["grad_norm_sum"] / steps)
+                _counters.set_gauge("train.skip_steps", acc["skip_sum"])
 
     def _raise_if_nonfinite(self, checks, window=1):
         """FLAGS_check_nan_inf host side: pull the traced finite-ness bits
@@ -892,6 +1158,11 @@ class CompiledTrainStep:
                      else f"train step {gstep}")
             stack = _trace.current_stack()
             ctx = f" [active spans: {' > '.join(stack)}]" if stack else ""
+            # postmortem before the raise: the flight bundle names the
+            # failing step and the non-finite tensors
+            _flight.dump("nan_inf", {
+                "step": gstep, "window": window, "window_index": i,
+                "bad": bad[:32], "where": where})
             raise FloatingPointError(
                 f"FLAGS_check_nan_inf: non-finite values at {where}: "
                 f"{shown}{ctx}")

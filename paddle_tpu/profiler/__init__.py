@@ -10,6 +10,16 @@ Pieces:
 * ``counters`` — process-global counter/gauge registry fed by the jit /
   static / io / distributed / optimizer hot paths (compile counts, cache
   hits, retraces, host syncs, device_put bytes, prefetch stalls, ...).
+* ``metrics`` — the telemetry layer on top of the registry: mergeable
+  log-bucket ``Histogram`` (p50/p95/p99 for serving TTFT / inter-token
+  latency / queue wait / checkpoint latency), ``MetricsLogger`` (JSONL
+  per-step train metrics accumulated in-graph by
+  ``jit.CompiledTrainStep(metrics=...)``), Prometheus text exposition,
+  and per-compiled-program HBM/compile/FLOPs telemetry
+  (``memory_summary()``, gated by ``FLAGS_device_telemetry``).
+* ``flight`` — always-on flight-recorder ring buffer; faults (trainer
+  recovery, nan/inf raise, fleet replica death/stall) dump a postmortem
+  JSON bundle (``scripts/flight_dump.py`` pretty-prints it).
 * ``Profiler`` — the paddle.profiler front end: scheduler state machine,
   ``on_trace_ready`` handlers (``export_chrome_tracing``), ``summary()``,
   and ``timer_only=True`` step benchmarking (ips + reader/batch cost split).
@@ -28,8 +38,12 @@ import time
 from enum import Enum
 
 from . import counters  # noqa: F401
+from . import flight  # noqa: F401
 from . import host_tracer  # noqa: F401
+from . import metrics  # noqa: F401
 from .host_tracer import current_stack, span  # noqa: F401
+from .metrics import (Histogram, MetricsLogger, memory_summary,  # noqa: F401
+                      prometheus_text)
 
 
 class ProfilerTarget(Enum):

@@ -46,6 +46,17 @@ Well-known names (see README "Observability" for the full table):
   resilience.save_failures / resilience.gc_removed
   resilience.faults_injected / resilience.faults_injected.<site>
   io.skipped_batches (replay-to-offset batches skipped on resume)
+  train.steps_accum / train.loss_mean / train.grad_norm_mean /
+  train.skip_steps (gauges: donated in-graph metric accumulator,
+      harvested by metrics_flush at sync boundaries)
+  flight.dumps / flight.dumps.<reason> (postmortem bundles written)
+  program.<name>.<field> (gauges: per-compiled-program HBM bytes /
+      compile seconds / FLOPs under FLAGS_device_telemetry)
+
+Latency *distributions* (serving.ttft_ns, serving.itl_ns,
+serving.queue_wait_ns, io.prefetch_stall_ns, resilience.save_ms, ...)
+live in profiler.metrics histograms; the migrated ``*_ns``/``*_ms``
+names above keep ticking here as plain sums for back-compat.
 """
 
 from __future__ import annotations

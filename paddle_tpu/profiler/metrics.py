@@ -1,0 +1,482 @@
+"""Metrics layer: histograms, a structured train-metrics logger, and
+per-compiled-program device telemetry.
+
+Reference analogue: Paddle's always-on profiler stats + fleet metric
+tables (SURVEY §"Metrics / logging / observability") — here grown into a
+production telemetry subsystem on top of :mod:`profiler.counters`:
+
+* :class:`Histogram` — fixed log2-bucket latency/occupancy histogram:
+  mergeable across threads/replicas (same bucket layout everywhere),
+  exact count/sum/min/max, p50/p95/p99 with bounded relative error.
+  The module-level registry (:func:`observe`, :func:`get_histogram`)
+  replaces bare ``*_ns`` accumulator counters for serving TTFT,
+  inter-token latency, queue wait, batch occupancy and checkpoint
+  save/restore latency — while ``observe(..., sum_counter=True)`` keeps
+  feeding the legacy counter name as a plain sum so every existing
+  ``check_counters.py`` gate stays green.
+* :class:`MetricsLogger` — structured JSONL time-series of per-step train
+  metrics (loss, grad global-norm, lr, scaler scale/skip, tok/s,
+  step-time, MFU) with an in-memory query API (:meth:`series`,
+  :meth:`latest`) and Prometheus text exposition
+  (:func:`prometheus_text`).  ``jit.CompiledTrainStep(metrics=logger)``
+  accumulates the device-derived scalars INSIDE the donated carry and
+  hands them to the logger only at existing sync boundaries — metrics-ON
+  runs add zero syncs/retraces/dispatches (counter-gated in
+  ``scripts/check_counters.py``).
+* device telemetry — :func:`capture_program_stats` records per-compiled-
+  program HBM usage (argument/output/temp bytes from XLA memory
+  analysis), compile wall-time and cost-analysis FLOPs at the compile
+  sites of ``jit`` and ``serving.engine`` (gated by
+  ``FLAGS_device_telemetry`` — the AOT lower+compile is a second compile,
+  paid only when the flag is on), exposed as ``program.*`` gauges and a
+  :func:`memory_summary` table.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+
+from ..core import flags as _flags
+from . import counters as _counters
+
+# one shared bucket layout so ANY two histograms merge: bucket i holds
+# values in [2**(i - _OFFSET), 2**(i - _OFFSET + 1)); i=0 additionally
+# absorbs zero/negative/underflow values
+_NBUCKETS = 100
+_OFFSET = 36  # bucket 0 lower bound 2**-36 — covers sub-ns .. 2**64 (ns scale)
+
+
+def _bucket_index(value):
+    if value <= 0.0:
+        return 0
+    # frexp: value = m * 2**e with 0.5 <= m < 1  =>  floor(log2(v)) == e - 1
+    _, e = math.frexp(value)
+    i = e - 1 + _OFFSET
+    if i < 0:
+        return 0
+    if i >= _NBUCKETS:
+        return _NBUCKETS - 1
+    return i
+
+
+def _bucket_bounds(i):
+    return 2.0 ** (i - _OFFSET), 2.0 ** (i - _OFFSET + 1)
+
+
+class Histogram:
+    """Fixed log2-bucket histogram: O(1) record, mergeable, percentiles.
+
+    Every instance shares one bucket layout, so histograms recorded by
+    different engine replicas (or loaded from :meth:`to_dict` bundles)
+    merge by plain element-wise addition.  ``count/sum/min/max`` are
+    exact; percentiles carry the bucket's <=2x relative error, clamped to
+    the observed [min, max] (a single-value histogram reports exact
+    percentiles)."""
+
+    __slots__ = ("name", "unit", "_lock", "_buckets", "count", "sum",
+                 "min", "max")
+
+    def __init__(self, name="", unit=""):
+        self.name = name
+        self.unit = unit
+        self._lock = threading.Lock()
+        self._buckets = [0] * _NBUCKETS
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def record(self, value):
+        value = float(value)
+        i = _bucket_index(value)
+        with self._lock:
+            self._buckets[i] += 1
+            self.count += 1
+            self.sum += value
+            if value < self.min:
+                self.min = value
+            if value > self.max:
+                self.max = value
+
+    def merge(self, other):
+        """In-place element-wise merge of ``other`` into ``self``."""
+        with other._lock:
+            ob = list(other._buckets)
+            oc, osum, omin, omax = (other.count, other.sum, other.min,
+                                    other.max)
+        with self._lock:
+            for i, n in enumerate(ob):
+                self._buckets[i] += n
+            self.count += oc
+            self.sum += osum
+            if omin < self.min:
+                self.min = omin
+            if omax > self.max:
+                self.max = omax
+        return self
+
+    def copy(self):
+        out = Histogram(self.name, self.unit)
+        out.merge(self)
+        return out
+
+    def percentile(self, q):
+        """Nearest-rank percentile from the bucket counts (0 when empty).
+        ``q`` in [0, 100]."""
+        with self._lock:
+            if self.count == 0:
+                return 0.0
+            rank = max(1, math.ceil((q / 100.0) * self.count))
+            cum = 0
+            for i, n in enumerate(self._buckets):
+                cum += n
+                if cum >= rank:
+                    lo, hi = _bucket_bounds(i)
+                    # geometric bucket midpoint, clamped to observed range
+                    mid = math.sqrt(lo * hi) if lo > 0 else 0.0
+                    return min(max(mid, self.min), self.max)
+            return self.max  # unreachable (cum == count by loop end)
+
+    @property
+    def mean(self):
+        return self.sum / self.count if self.count else 0.0
+
+    def summary(self):
+        """Compact stats dict: count/sum/mean/min/max/p50/p95/p99."""
+        if self.count == 0:
+            return {"count": 0, "sum": 0.0, "mean": 0.0, "min": 0.0,
+                    "max": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+        return {"count": self.count, "sum": self.sum, "mean": self.mean,
+                "min": self.min, "max": self.max,
+                "p50": self.percentile(50), "p95": self.percentile(95),
+                "p99": self.percentile(99)}
+
+    def to_dict(self):
+        """JSON-safe form (sparse buckets) — the fleet/flight wire format."""
+        with self._lock:
+            return {"name": self.name, "unit": self.unit,
+                    "count": self.count, "sum": self.sum,
+                    "min": self.min if self.count else None,
+                    "max": self.max if self.count else None,
+                    "buckets": {str(i): n for i, n in
+                                enumerate(self._buckets) if n}}
+
+    @classmethod
+    def from_dict(cls, d):
+        out = cls(d.get("name", ""), d.get("unit", ""))
+        for i, n in d.get("buckets", {}).items():
+            out._buckets[int(i)] = int(n)
+        out.count = int(d.get("count", 0))
+        out.sum = float(d.get("sum", 0.0))
+        if out.count:
+            out.min = float(d["min"])
+            out.max = float(d["max"])
+        return out
+
+
+# -- module-level histogram registry ----------------------------------------
+_HLOCK = threading.Lock()
+_HISTS: dict[str, Histogram] = {}
+
+
+def get_histogram(name: str, unit: str = "") -> Histogram:
+    """The process-global histogram registered under ``name`` (created on
+    first use)."""
+    with _HLOCK:
+        h = _HISTS.get(name)
+        if h is None:
+            h = _HISTS[name] = Histogram(name, unit)
+        return h
+
+
+def observe(name: str, value, unit: str = "", sum_counter=False,
+            extra: Histogram | None = None):
+    """Record ``value`` into the global histogram ``name``.
+
+    ``sum_counter=True`` ALSO bumps the plain counter of the same name by
+    ``value`` (the legacy-accumulator back-compat path for migrated
+    ``*_ns`` / ``*_ms`` counters); a string bumps that counter name
+    instead.  ``extra`` additionally records into a caller-scoped
+    histogram (per-replica engine stats the Router later merges)."""
+    get_histogram(name, unit).record(value)
+    if extra is not None:
+        extra.record(value)
+    if sum_counter:
+        _counters.inc(name if sum_counter is True else sum_counter, value)
+
+
+def histograms() -> dict:
+    """Point-in-time copies of every registered histogram."""
+    with _HLOCK:
+        items = list(_HISTS.items())
+    return {k: h.copy() for k, h in items}
+
+
+def histogram_summaries() -> dict:
+    """``{name: summary-dict}`` for every non-empty registered histogram."""
+    return {k: h.summary() for k, h in histograms().items() if h.count}
+
+
+def reset_metrics():
+    """Drop every registered histogram and program record (test isolation)."""
+    with _HLOCK:
+        _HISTS.clear()
+    with _PLOCK:
+        _PROGRAMS.clear()
+
+
+# -- structured train-metrics logger ----------------------------------------
+class MetricsLogger:
+    """Structured JSONL time-series + in-memory query API.
+
+    One :meth:`log` call is one JSONL line::
+
+        {"ts": <unix-seconds>, "step": <int>, "<metric>": <float>, ...}
+
+    plus one in-memory ``(step, value)`` point per metric, queryable with
+    :meth:`series`/:meth:`latest`.  ``path=None`` keeps the series
+    memory-only.  Thread-safe; writes are line-buffered appends (crash
+    keeps every completed line).  Wire it into the train loop with
+    ``jit.CompiledTrainStep(model, loss_fn, opt, metrics=logger)`` — the
+    in-graph accumulation + sync-boundary harvest keeps the hot path free
+    of extra syncs/dispatches."""
+
+    def __init__(self, path=None, run=None):
+        self.path = os.fspath(path) if path is not None else None
+        self.run = run
+        self._lock = threading.Lock()
+        self._series: dict[str, list] = {}
+        self._fh = None
+        if self.path is not None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a", buffering=1)
+
+    def log(self, step=None, **metrics):
+        """Record one time point: ``logger.log(step=3, loss=2.17, lr=1e-4)``."""
+        rec = {"ts": time.time()}
+        if self.run is not None:
+            rec["run"] = self.run
+        if step is not None:
+            rec["step"] = int(step)
+        for k, v in metrics.items():
+            if v is None:
+                continue
+            rec[k] = float(v)
+        with self._lock:
+            for k, v in rec.items():
+                if k in ("ts", "run", "step"):
+                    continue
+                self._series.setdefault(k, []).append((rec.get("step"), v))
+            if self._fh is not None:
+                self._fh.write(json.dumps(rec) + "\n")
+        return rec
+
+    def series(self, name):
+        """All recorded ``(step, value)`` points for one metric, in order."""
+        with self._lock:
+            return list(self._series.get(name, ()))
+
+    def latest(self, name, default=None):
+        with self._lock:
+            pts = self._series.get(name)
+            return pts[-1][1] if pts else default
+
+    def names(self):
+        with self._lock:
+            return sorted(self._series)
+
+    def summary(self):
+        """``{metric: {count, last, mean, min, max}}`` over the series."""
+        with self._lock:
+            items = {k: [v for _, v in pts]
+                     for k, pts in self._series.items()}
+        return {k: {"count": len(vs), "last": vs[-1],
+                    "mean": sum(vs) / len(vs), "min": min(vs),
+                    "max": max(vs)}
+                for k, vs in items.items() if vs}
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _prom_name(name):
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    n = "".join(out)
+    return n if (n and not n[0].isdigit()) else "_" + n
+
+
+def prometheus_text(logger: MetricsLogger | None = None) -> str:
+    """Prometheus text exposition of the full telemetry state: every
+    counter as ``counter``, every gauge as ``gauge``, every histogram as
+    ``summary`` quantiles (+ ``_sum``/``_count``), and optionally the
+    latest point of each :class:`MetricsLogger` series."""
+    lines = []
+    snap = _counters.snapshot()
+    gauges = {k: snap[k] for k in snap
+              if k in getattr(_counters, "_GAUGES", {})}
+    for k in sorted(snap):
+        pn = "ptpu_" + _prom_name(k)
+        kind = "gauge" if k in gauges else "counter"
+        lines.append(f"# TYPE {pn} {kind}")
+        lines.append(f"{pn} {snap[k]}")
+    for k, h in sorted(histograms().items()):
+        if not h.count:
+            continue
+        pn = "ptpu_" + _prom_name(k)
+        lines.append(f"# TYPE {pn} summary")
+        for q in (0.5, 0.95, 0.99):
+            lines.append(f'{pn}{{quantile="{q}"}} {h.percentile(q * 100)}')
+        lines.append(f"{pn}_sum {h.sum}")
+        lines.append(f"{pn}_count {h.count}")
+    if logger is not None:
+        for k in logger.names():
+            pn = "ptpu_metric_" + _prom_name(k)
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {logger.latest(k)}")
+    return "\n".join(lines) + "\n"
+
+
+# -- per-compiled-program device telemetry ----------------------------------
+_PLOCK = threading.Lock()
+_PROGRAMS: dict[str, dict] = {}
+
+_MEM_FIELDS = (("arg_bytes", "argument_size_in_bytes"),
+               ("out_bytes", "output_size_in_bytes"),
+               ("temp_bytes", "temp_size_in_bytes"),
+               ("alias_bytes", "alias_size_in_bytes"),
+               ("code_bytes", "generated_code_size_in_bytes"))
+
+
+def device_telemetry_enabled() -> bool:
+    return bool(_flags.flag("FLAGS_device_telemetry"))
+
+
+def capture_program_stats(name, jit_fn, *args, **kwargs):
+    """AOT-lower+compile ``jit_fn`` on the given abstract/concrete args and
+    record HBM usage (argument/output/temp bytes from XLA memory
+    analysis), compile wall-time and cost-analysis FLOPs under
+    ``program.<name>.*`` gauges + the :func:`memory_summary` table.
+
+    Gated by ``FLAGS_device_telemetry`` (this is a SECOND compile of the
+    same program — jit's dispatch cache is separate from the AOT path —
+    so it is paid only when telemetry is explicitly on, e.g. by the bench
+    mesh legs).  Every backend quirk (CPU test backends without memory
+    analysis, version-dependent cost-analysis shapes) degrades to partial
+    records, never an exception on the caller's hot path."""
+    if not device_telemetry_enabled():
+        return None
+    rec = {"name": name, "compile_s": None, "flops": None}
+    for k, _ in _MEM_FIELDS:
+        rec[k] = None
+    try:
+        t0 = time.perf_counter()
+        compiled = jit_fn.lower(*args, **kwargs).compile()
+        rec["compile_s"] = time.perf_counter() - t0
+        try:
+            ma = compiled.memory_analysis()
+            for k, attr in _MEM_FIELDS:
+                v = getattr(ma, attr, None)
+                if v is not None:
+                    rec[k] = int(v)
+        except Exception:
+            pass
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)) and ca:
+                ca = ca[0]
+            if isinstance(ca, dict) and ca.get("flops"):
+                rec["flops"] = float(ca["flops"])
+        except Exception:
+            pass
+    except Exception as e:  # lowering itself failed — record the miss
+        rec["error"] = f"{type(e).__name__}: {e}"
+    record_program(name, **{k: v for k, v in rec.items() if k != "name"})
+    return rec
+
+
+def record_program(name, **fields):
+    """Register/refresh one compiled-program telemetry record and mirror
+    the byte/flops fields as ``program.<name>.*`` gauges."""
+    with _PLOCK:
+        rec = _PROGRAMS.setdefault(name, {"name": name})
+        rec.update({k: v for k, v in fields.items() if v is not None})
+    for k, v in fields.items():
+        if v is not None and isinstance(v, (int, float)):
+            _counters.set_gauge(f"program.{name}.{k}", v)
+    return program_stats(name)
+
+
+def program_stats(name=None):
+    """One program's record, or ``{name: record}`` for all of them."""
+    with _PLOCK:
+        if name is not None:
+            return dict(_PROGRAMS.get(name, {}))
+        return {k: dict(v) for k, v in _PROGRAMS.items()}
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    for u in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or u == "TiB":
+            return f"{n:.1f}{u}" if u != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def memory_summary() -> str:
+    """Human table of per-compiled-program HBM usage / compile time /
+    FLOPs — the ``paddle.device.cuda.memory_summary`` analogue for the
+    XLA program set this process compiled."""
+    progs = program_stats()
+    if not progs:
+        return "(no compiled-program telemetry recorded — set " \
+               "FLAGS_device_telemetry=1 before compiling)"
+    headers = ("Program", "Args", "Outputs", "Temp", "Code", "Compile(s)",
+               "GFLOPs")
+    rows = []
+    for name in sorted(progs):
+        r = progs[name]
+        rows.append((
+            name,
+            _fmt_bytes(r.get("arg_bytes")),
+            _fmt_bytes(r.get("out_bytes")),
+            _fmt_bytes(r.get("temp_bytes")),
+            _fmt_bytes(r.get("code_bytes")),
+            f"{r['compile_s']:.3f}" if r.get("compile_s") is not None
+            else "-",
+            f"{r['flops'] / 1e9:.2f}" if r.get("flops") else "-"))
+    widths = [max(len(h), *(len(row[i]) for row in rows))
+              for i, h in enumerate(headers)]
+    fmt = "  ".join("{:<%d}" % w for w in widths)
+    lines = [fmt.format(*headers), fmt.format(*("-" * w for w in widths))]
+    lines.extend(fmt.format(*row) for row in rows)
+    return "\n".join(lines)
+
+
+_flags.define_flag(
+    "FLAGS_device_telemetry", False,
+    "Record per-compiled-program HBM usage / compile time / FLOPs at jit "
+    "and serving compile sites (metrics.capture_program_stats). Costs one "
+    "extra AOT compile per program — off by default.")
+_flags.define_flag(
+    "FLAGS_peak_tflops", 0.0,
+    "Accelerator peak TFLOP/s for MFU attribution in train metrics "
+    "(0 disables the mfu field; v5e bf16 honest peak is 197).")

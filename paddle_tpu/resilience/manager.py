@@ -51,7 +51,9 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..distributed import checkpoint as _dckpt
 from ..profiler import counters as _counters
+from ..profiler import flight as _flight
 from ..profiler import host_tracer as _trace
+from ..profiler import metrics as _metrics
 from ..tensor.random import default_generator
 from . import faultinject as _fi
 
@@ -319,8 +321,10 @@ class CheckpointManager:
         except OSError:
             pass
         _counters.inc("resilience.saves")
-        _counters.inc("resilience.save_ms",
-                      int((time.perf_counter() - t0) * 1000))
+        save_ms = int((time.perf_counter() - t0) * 1000)
+        _metrics.observe("resilience.save_ms", save_ms, unit="ms",
+                         sum_counter=True)
+        _flight.record("ckpt.save", step=step_no, ms=save_ms)
         self._gc()
 
     def _gc(self):
@@ -357,9 +361,15 @@ class CheckpointManager:
         for step_no in reversed(self._committed()):
             path = self._dir(step_no)
             try:
+                t0 = time.perf_counter()
                 with _trace.span("resilience.restore"):
                     info = self._restore_from(path, train_step, scheduler)
                 _counters.inc("resilience.restores")
+                restore_ms = (time.perf_counter() - t0) * 1000
+                _metrics.observe("resilience.restore_ms", restore_ms,
+                                 unit="ms")
+                _flight.record("ckpt.restore", step=info["step"],
+                               ms=int(restore_ms))
                 return info
             except (CheckpointCorrupt, ValueError, KeyError, OSError,
                     json.JSONDecodeError) as e:

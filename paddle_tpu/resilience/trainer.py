@@ -29,6 +29,7 @@ import numpy as np
 
 from ..io import DevicePrefetcher, StackingPrefetcher, Window
 from ..profiler import counters as _counters
+from ..profiler import flight as _flight
 from ..profiler import host_tracer as _trace
 from . import faultinject as _fi
 
@@ -126,6 +127,15 @@ class FaultTolerantTrainer:
     def _recover(self, exc):
         _counters.inc("resilience.recoveries")
         _counters.inc(f"resilience.recovered.{type(exc).__name__}")
+        # postmortem FIRST, while the ring still holds the events leading
+        # into the fault (restore itself appends events)
+        _flight.dump("trainer_recover", {
+            "error": f"{type(exc).__name__}: {exc}",
+            "global_step": self.global_step,
+            "epoch": self._epoch,
+            "offset": self._offset,
+            "recoveries": self.recoveries,
+        })
         # a concurrently failing async save must not mask the recovery —
         # the checkpoint set on disk is what matters now
         self.manager.wait(suppress=True)

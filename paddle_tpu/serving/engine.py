@@ -43,6 +43,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..profiler import counters
+from ..profiler import flight
+from ..profiler import metrics
 from ..profiler.host_tracer import span
 from .sampling import filter_logits
 
@@ -80,7 +82,8 @@ class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "do_sample",
                  "temperature", "top_k", "top_p", "eos_token_id", "seed",
                  "state", "finish_reason", "tokens", "slot", "arrival_ns",
-                 "deadline", "_cancel", "_engine", "error", "tag")
+                 "last_emit_ns", "deadline", "_cancel", "_engine", "error",
+                 "tag")
 
     def __init__(self, rid, prompt, max_new_tokens, do_sample, temperature,
                  top_k, top_p, eos_token_id, seed, deadline, engine):
@@ -100,6 +103,7 @@ class Request:
         self.tokens = []          # generated tokens (includes eos if hit)
         self.slot = None
         self.arrival_ns = time.monotonic_ns()
+        self.last_emit_ns = None  # monotonic_ns of the last emitted token
         self.deadline = deadline  # absolute time.monotonic() or None
         self._cancel = False
         self._engine = engine
@@ -209,6 +213,37 @@ class LLMEngine:
         self._prefill_jits = {}   # bucket -> jitted prefill
         self._insert_jits = {}    # bucket -> jitted insert
         self._decode_jit = None
+        self._captured = set()    # program names already sent to telemetry
+
+        # per-engine mergeable latency/occupancy histograms — the fleet
+        # Router merges these across replicas for fleet-wide percentiles;
+        # every observation also feeds the process-global registry under
+        # the same serving.* name
+        self.hists = {
+            n: metrics.Histogram(n, unit)
+            for n, unit in (("serving.ttft_ns", "ns"),
+                            ("serving.itl_ns", "ns"),
+                            ("serving.queue_wait_ns", "ns"),
+                            ("serving.prefill_occupancy", "frac"),
+                            ("serving.decode_occupancy", "frac"))}
+
+    def _observe(self, name, value, sum_counter=False):
+        metrics.observe(name, value, sum_counter=sum_counter,
+                        extra=self.hists[name])
+
+    def _maybe_capture(self, name, fn, *args):
+        """Record HBM/compile/FLOPs stats for a compiled program, once per
+        program name (gated by FLAGS_device_telemetry; the AOT lower costs
+        a second trace, so the serving.retraces warm-path invariant only
+        holds with telemetry off)."""
+        if metrics.device_telemetry_enabled() and name not in self._captured:
+            self._captured.add(name)
+            metrics.capture_program_stats(name, fn, *args)
+
+    def histogram_snapshot(self):
+        """Copies of the per-engine histograms (point-in-time, safe to
+        ``Histogram.merge`` across replicas — the fleet Router does)."""
+        return {n: h.copy() for n, h in self.hists.items()}
 
     # -- compiled programs ---------------------------------------------------
     def _first_token(self, logits, key, do_sample, temp, top_k, top_p):
@@ -331,6 +366,8 @@ class LLMEngine:
             self._queue.append(req)
             self._outstanding += req.max_new_tokens
         counters.inc("serving.requests")
+        flight.record("serving.request", rid=req.rid, prompt_len=T,
+                      max_new_tokens=req.max_new_tokens)
         return req
 
     def _retry_hint_locked(self):
@@ -363,6 +400,8 @@ class LLMEngine:
                 req.slot = None
         counters.inc("serving.evictions")
         counters.inc(f"serving.evictions.{reason}")
+        flight.record("serving.finish", rid=req.rid, reason=reason,
+                      tokens=len(req.tokens))
         events.append({"type": "finished", "request": req, "reason": reason})
         return True
 
@@ -402,6 +441,12 @@ class LLMEngine:
         replay prefix check) see ``req.tokens`` already advanced past this
         token when one step emits several (prefill + same-step decode)."""
         req.tokens.append(int(tok))
+        now_ns = time.monotonic_ns()
+        if len(req.tokens) == 1:
+            self._observe("serving.ttft_ns", now_ns - req.arrival_ns)
+        elif req.last_emit_ns is not None:
+            self._observe("serving.itl_ns", now_ns - req.last_emit_ns)
+        req.last_emit_ns = now_ns
         with self._cond:
             self._outstanding -= 1
         events.append({"type": "token", "request": req, "token": int(tok),
@@ -426,24 +471,34 @@ class LLMEngine:
                 counters.inc("serving.deadline_expired")
                 self._finish(req, "deadline", events)
                 continue
-            counters.inc("serving.queue_wait_ns",
-                         time.monotonic_ns() - req.arrival_ns)
+            self._observe("serving.queue_wait_ns",
+                          time.monotonic_ns() - req.arrival_ns,
+                          sum_counter=True)
             slot = self._free.pop()
             try:
                 from ..resilience import faultinject as _fi
                 _fi.maybe_fault("serving_prefill", req.rid)
                 T = int(req.prompt.shape[0])
                 bucket = bucket_length(T, self.min_bucket, self.max_seq_len)
+                self._observe("serving.prefill_occupancy", T / bucket)
                 ids = np.zeros((1, bucket), np.int32)
                 ids[0, :T] = req.prompt
                 key_data = np.asarray(
                     jax.random.key_data(jax.random.key(req.seed)))
                 with span("serving.prefill"):
-                    kc, vc, tok, new_key = self._prefill_for(bucket)(
-                        self._w, jnp.asarray(ids), np.int32(T), key_data,
-                        np.bool_(req.do_sample), np.float32(req.temperature),
-                        np.int32(req.top_k), np.float32(req.top_p))
-                    self._ck, self._cv = self._insert_for(bucket)(
+                    pf = self._prefill_for(bucket)
+                    pargs = (self._w, jnp.asarray(ids), np.int32(T),
+                             key_data, np.bool_(req.do_sample),
+                             np.float32(req.temperature),
+                             np.int32(req.top_k), np.float32(req.top_p))
+                    self._maybe_capture(f"serving.prefill[b{bucket}]",
+                                        pf, *pargs)
+                    kc, vc, tok, new_key = pf(*pargs)
+                    ins = self._insert_for(bucket)
+                    self._maybe_capture(f"serving.insert[b{bucket}]", ins,
+                                        self._ck, self._cv, kc, vc,
+                                        np.int32(slot))
+                    self._ck, self._cv = ins(
                         self._ck, self._cv, kc, vc, np.int32(slot))
             except Exception as e:
                 # a poisoned request (bad prompt, injected fault, prefill
@@ -472,14 +527,18 @@ class LLMEngine:
         active = [(s, r) for s, r in enumerate(self._slots) if r is not None]
         if not active:
             return
+        self._observe("serving.decode_occupancy",
+                      len(active) / self.max_slots)
         t0 = time.perf_counter()
         with span("serving.decode"):
-            nxt, self._ck, self._cv, new_keys = self._decode()(
-                self._w, self._ck, self._cv,
-                jnp.asarray(self._tok), jnp.asarray(self._pos),
-                jnp.asarray(self._keys), jnp.asarray(self._dosample),
-                jnp.asarray(self._temp), jnp.asarray(self._topk),
-                jnp.asarray(self._topp))
+            dec = self._decode()
+            dargs = (self._w, self._ck, self._cv,
+                     jnp.asarray(self._tok), jnp.asarray(self._pos),
+                     jnp.asarray(self._keys), jnp.asarray(self._dosample),
+                     jnp.asarray(self._temp), jnp.asarray(self._topk),
+                     jnp.asarray(self._topp))
+            self._maybe_capture("serving.decode", dec, *dargs)
+            nxt, self._ck, self._cv, new_keys = dec(*dargs)
             nxt = np.asarray(nxt)
         self._keys = np.array(new_keys)  # mutable host copy
         inst = len(active) / max(time.perf_counter() - t0, 1e-9)

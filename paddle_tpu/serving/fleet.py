@@ -52,6 +52,7 @@ from collections import deque
 import numpy as np
 
 from ..profiler import counters
+from ..profiler import flight
 from ..profiler.host_tracer import span
 from ..resilience import faultinject
 from .engine import (EngineBackpressure, EngineClosed, LLMEngine,
@@ -299,10 +300,23 @@ class ServingFleet:
         eng = rep.engine
         with eng._cond:
             eng._closed = True
-            stranded = [r for r in eng._slots if r is not None]
-            stranded += list(eng._queue)
+            in_flight = [r for r in eng._slots if r is not None]
+            queued = list(eng._queue)
+            stranded = in_flight + queued
             eng._queue.clear()
             eng._cond.notify_all()
+        # postmortem bundle BEFORE respawn/requeue mutate anything: names
+        # the dead replica and exactly which requests it was holding
+        flight.dump("replica_died", {
+            "replica": rep.idx,
+            "reason": reason,
+            "error": repr(exc) if exc is not None else None,
+            "steps": rep.steps,
+            "in_flight_rids": [r.rid for r in in_flight],
+            "queued_rids": [r.rid for r in queued],
+            "fleet_rids": [r.tag.rid for r in stranded
+                           if r.tag is not None],
+        })
         # the arena of a dead replica is garbage; release its HBM now
         eng._ck = eng._cv = None
         requeue = []
@@ -660,6 +674,7 @@ class ServingFleet:
         return {"replicas": reps,
                 "alive": sum(r.alive for r in replicas),
                 "decode_tps": agg,
+                "latency": self.router.latency_summary(replicas),
                 "pending_retries": pending,
                 "requests": total,
                 "unfinished": sum(1 for f in self._requests

@@ -29,6 +29,7 @@ layer; the shedding rule is classic early-deadline-drop admission control.
 from __future__ import annotations
 
 from ..profiler import counters
+from ..profiler.metrics import Histogram
 from .engine import EngineBackpressure
 
 __all__ = ["RetryAfter", "Router"]
@@ -64,6 +65,28 @@ class Router:
 
     def __init__(self, slo_margin=1.0):
         self.slo_margin = float(slo_margin)
+
+    @staticmethod
+    def aggregate_histograms(replicas):
+        """Merge the per-engine latency/occupancy histograms across
+        replicas into fleet-wide ``Histogram``s, keyed by metric name
+        (``serving.ttft_ns``, ``serving.itl_ns``, ...).  Dead replicas
+        merge too: latency a client already experienced counts toward the
+        fleet percentiles whatever later happened to the replica."""
+        agg = {}
+        for rep in replicas:
+            for name, h in rep.engine.histogram_snapshot().items():
+                if name not in agg:
+                    agg[name] = Histogram(name, h.unit)
+                agg[name].merge(h)
+        return agg
+
+    @staticmethod
+    def latency_summary(replicas):
+        """``{name: {count, mean, min, max, p50, p95, p99}}`` over the
+        merged fleet histograms (the fleet ``stats()`` embeds this)."""
+        return {n: h.summary()
+                for n, h in Router.aggregate_histograms(replicas).items()}
 
     def pick(self, replicas, est_tokens=0, deadline_s=None, shed=True):
         """Choose a replica for a request costing ``est_tokens`` decode

@@ -20,6 +20,12 @@ else — zero retraces, zero rehydrates, zero ``layer_state``/
 ``optimizer_state`` host reads; the disk write overlaps the next window
 on a background thread.
 
+A flight-recorder phase injects a ``nan_loss`` fault into a tiny
+``FaultTolerantTrainer`` run and gates the postmortem contract: recovery
+must leave exactly one flight dump (reason ``trainer_recover``) whose
+context names the ``NonFiniteLossError``, while the run itself still
+finishes with finite losses.
+
 A serving phase runs mixed-length staggered requests through
 ``serving.LLMEngine`` and asserts the outputs are TOKEN-IDENTICAL to
 sequential per-request ``GPT.generate``; it reports decode tokens/s for
@@ -130,6 +136,44 @@ def run():
         - rdelta.get("jit.syncs", 0)
         - rdelta.get("jit.host.bind_layer_state", 0)
         - rdelta.get("jit.host.bind_optimizer_state", 0))
+
+    # ---- flight recorder: an injected NaN fault must leave a postmortem -
+    import paddle_tpu.nn as nn
+    from paddle_tpu.io import DataLoader, TensorDataset
+    from paddle_tpu.profiler import flight
+    from paddle_tpu.resilience import (CheckpointManager as _CkptMgr,
+                                       FaultTolerantTrainer, faultinject)
+
+    def _mse(m, x, y):
+        return ((m(x) - y) ** 2).mean()
+
+    paddle.seed(0)
+    fnet = nn.Sequential(nn.Linear(6, 12), nn.GELU(), nn.Linear(12, 3))
+    fr_opt = paddle.optimizer.AdamW(5e-2, parameters=fnet.parameters())
+    fr_step = pjit.CompiledTrainStep(fnet, _mse, fr_opt)
+    frng = np.random.RandomState(3)
+    fr_ds = TensorDataset(
+        [paddle.to_tensor(frng.randn(32, 6).astype("float32")),
+         paddle.to_tensor(frng.randn(32, 3).astype("float32"))])
+    with tempfile.TemporaryDirectory() as fdir:
+        flight.configure(directory=fdir)
+        flight.clear()
+        with faultinject.fault_schedule("nan_loss@3"):
+            trainer = FaultTolerantTrainer(
+                fr_step, lambda epoch: DataLoader(fr_ds, batch_size=4,
+                                                  shuffle=False),
+                _CkptMgr(os.path.join(fdir, "ckpt"), keep_last=2),
+                epochs=1, max_steps=6, save_every=2)
+            fr_losses = trainer.run()
+        fr_dump_path = flight.last_dump_path()
+        fr_bundle = flight.load(fr_dump_path) if fr_dump_path else {}
+        flight.configure(directory="")
+    flight_phase = {
+        "flight_nan_recoveries": trainer.recoveries,
+        "flight_dump_reason": fr_bundle.get("reason"),
+        "flight_dump_error": (fr_bundle.get("context") or {}).get("error"),
+        "flight_dump_events": len(fr_bundle.get("events", [])),
+    }
 
     # ---- serving: engine output must match sequential generate ----------
     from paddle_tpu.serving import LLMEngine
@@ -242,6 +286,7 @@ def run():
               "serve_outputs_match_generate": outputs_match,
               "serve_steady_retraces": sdelta.get("serving.retraces", 0),
               "serve_prefill_programs": eng.stats()["prefill_programs"]}
+    result.update(flight_phase)
     result.update(mesh_phase)
     print(json.dumps(result))
     if sum(host_delta.values()) != 0:
@@ -283,6 +328,15 @@ def run():
     if not all(np.isfinite(l) for l in losses + flosses):
         raise AssertionError(
             f"non-finite loss in smoke run: {losses} / {flosses}")
+    if (trainer.recoveries != 1 or fr_dump_path is None
+            or fr_bundle.get("reason") != "trainer_recover"
+            or "NonFiniteLossError" not in (flight_phase["flight_dump_error"]
+                                            or "")
+            or not all(np.isfinite(v) for v in fr_losses.values())):
+        raise AssertionError(
+            "injected NaN fault did not produce a flight-recorder "
+            f"postmortem (or the recovery was unclean): {flight_phase}, "
+            f"dump={fr_dump_path}")
     if not outputs_match:
         raise AssertionError(
             "serving engine output diverged from sequential GPT.generate "

@@ -51,6 +51,15 @@ retraces / rehydrates / host binds, ``dispatches == MEASURE``, and
 the program, never host-issued) — and the fused-on-mesh run must keep
 ``dispatches == steps/K``.
 
+A seventh phase gates the telemetry subsystem's zero-overhead claim
+(``profiler.metrics``): every steady-state phase above (train, fused,
+mesh dp2, serving) is run twice with fresh objects — metrics OFF, then
+metrics ON (``CompiledTrainStep(metrics=True)``; telemetry harvested
+inside the measured window) — and the ``jit.syncs`` / ``jit.traces`` /
+``jit.host.dispatches`` / ``serving.retraces`` deltas must be IDENTICAL:
+in-graph metric accumulation and host-side harvesting add zero syncs,
+zero retraces, zero extra dispatches.
+
 Prints one JSON line; raises AssertionError on any violation.  Wired as a
 tier-1 test via tests/test_profiler.py.  Run directly:
 ``python scripts/check_counters.py``.
@@ -397,6 +406,96 @@ def run():
             np.isfinite(v) for v in rlosses.values()):
         violations["fault:trainer_losses"] = (len(rlosses), FAULT_STEPS)
 
+    # ---- metrics-parity gate: telemetry ON adds ZERO syncs / traces /
+    # dispatches / retraces to any steady-state phase.  Fresh objects per
+    # run so OFF and ON each pay the same warmup; the ON run harvests
+    # (metrics_flush / prometheus_text) INSIDE the measured window — the
+    # read path must be free too.
+    from paddle_tpu.profiler import metrics as pmetrics
+
+    PARITY_KEYS = ("jit.syncs", "jit.traces", "jit.host.dispatches",
+                   "serving.retraces")
+
+    def _pick(d):
+        return {k: d.get(k, 0) for k in PARITY_KEYS}
+
+    def train_phase(m):
+        paddle.seed(0)
+        tm = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+        topt = paddle.optimizer.AdamW(1e-3, parameters=tm.parameters())
+        ts = pjit.CompiledTrainStep(tm, loss_fn, topt,
+                                    metrics=True if m else None)
+        for _ in range(WARMUP):
+            ts(x, y).numpy()
+        b = counters.snapshot()
+        for _ in range(MEASURE):
+            ts(x, y).numpy()
+        if m:
+            ts.metrics_flush()
+        return _pick(counters.delta(b))
+
+    def fused_phase(m):
+        paddle.seed(0)
+        tm = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+        topt = paddle.optimizer.AdamW(1e-3, parameters=tm.parameters())
+        ts = pjit.CompiledTrainStep(tm, loss_fn, topt, fused_steps=FUSED_K,
+                                    metrics=True if m else None)
+        ts(window()).numpy()  # priming single-step fallback
+        ts(window()).numpy()  # scan compile
+        b = counters.snapshot()
+        for _ in range(FUSED_MEASURE):
+            ts(window()).numpy()
+        if m:
+            ts.metrics_flush()
+        return _pick(counters.delta(b))
+
+    def mesh_phase(m):
+        from jax.sharding import Mesh as _Mesh
+        mesh2 = _Mesh(np.array(jax.devices()[:2]).reshape(2), ("dp",))
+        paddle.seed(0)
+        tm = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+        topt = paddle.optimizer.AdamW(1e-3, parameters=tm.parameters())
+        ts = pjit.CompiledTrainStep(tm, loss_fn, topt, mesh=mesh2,
+                                    metrics=True if m else None)
+        for _ in range(WARMUP):
+            ts(x, y).numpy()
+        b = counters.snapshot()
+        for _ in range(MEASURE):
+            ts(x, y).numpy()
+        if m:
+            ts.metrics_flush()
+        return _pick(counters.delta(b))
+
+    def serve_phase(m):
+        paddle.seed(0)
+        e2 = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4)
+        rng2 = np.random.RandomState(7)
+
+        def sv(lens):
+            hs = [e2.add_request(rng2.randint(0, 64, size=n).tolist(),
+                                 max_new_tokens=3) for n in lens]
+            while not all(h.is_finished for h in hs):
+                e2.step()
+                if m:   # harvesting telemetry mid-serve must be free
+                    pmetrics.prometheus_text()
+                    pmetrics.histogram_summaries()
+
+        sv(SERVE_LENS_WARM)
+        b = counters.snapshot()
+        sv(SERVE_LENS_MEASURE)
+        return _pick(counters.delta(b))
+
+    parity_phases = [("train", train_phase), ("fused", fused_phase),
+                     ("serving", serve_phase)]
+    if jax.device_count() >= 2:
+        parity_phases.append(("mesh", mesh_phase))
+    metrics_parity = {}
+    for pname, pfn in parity_phases:
+        off, on = pfn(False), pfn(True)
+        metrics_parity[pname] = {"off": off, "on": on}
+        if on != off:
+            violations[f"metrics-parity:{pname}"] = (on, off)
+
     result = {"metric": "steady_state_counter_violations",
               "value": len(violations),
               "unit": f"violations/{MEASURE} steps "
@@ -416,7 +515,8 @@ def run():
               "ckpt_steady_delta": {k: v for k, v in csteady.items()
                                     if k.startswith(("jit.", "resilience."))},
               "fault_delta": {k: v for k, v in rsteady.items()
-                              if k.startswith("resilience.")}}
+                              if k.startswith("resilience.")},
+              "metrics_parity": metrics_parity}
     print(json.dumps(result))
     if violations:
         raise AssertionError(

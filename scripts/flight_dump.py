@@ -1,0 +1,119 @@
+"""Pretty-print flight-recorder postmortem bundles.
+
+Usage::
+
+    python scripts/flight_dump.py <bundle.json> [...]
+    python scripts/flight_dump.py <flight-dir>       # newest bundle
+    python scripts/flight_dump.py                    # newest in the
+                                                     # default dump dir
+
+Renders the bundle sections written by ``paddle_tpu.profiler.flight.dump``
+— reason/context header, active span stack, the counters that MOVED since
+startup (full snapshot stays in the JSON), histogram percentiles, and the
+event ring tail with relative timestamps.  ``--events N`` bounds the tail
+(default 40; 0 = all); ``--raw`` re-emits the bundle as indented JSON.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def _find_bundles(target):
+    if os.path.isfile(target):
+        return [target]
+    if os.path.isdir(target):
+        found = sorted(glob.glob(os.path.join(target, "flight-*.json")),
+                       key=os.path.getmtime)
+        if not found:
+            raise SystemExit(f"no flight-*.json bundles under {target}")
+        return [found[-1]]
+    raise SystemExit(f"{target}: not a bundle file or directory")
+
+
+def _default_dir():
+    # mirror flight.dump_dir() without importing jax transitively
+    import tempfile
+    return os.path.join(tempfile.gettempdir(), f"ptpu-flight-{os.getpid()}")
+
+
+def _fmt_val(v):
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def render(path, max_events=40, raw=False, out=sys.stdout):
+    with open(path) as f:
+        bundle = json.load(f)
+    if raw:
+        json.dump(bundle, out, indent=2)
+        out.write("\n")
+        return bundle
+
+    w = out.write
+    w(f"== flight bundle {path}\n")
+    w(f"reason   : {bundle.get('reason')}\n")
+    w(f"pid      : {bundle.get('pid')}   ts: {bundle.get('ts')}\n")
+    ctx = bundle.get("context") or {}
+    if ctx:
+        w("context  :\n")
+        for k in sorted(ctx):
+            w(f"  {k:<18} {_fmt_val(ctx[k])}\n")
+    spans = bundle.get("spans") or []
+    w(f"spans    : {' > '.join(spans) if spans else '(none active)'}\n")
+
+    moved = {k: v for k, v in (bundle.get("counters_delta") or {}).items()
+             if v}
+    if moved:
+        w(f"\n-- counters moved since startup ({len(moved)}):\n")
+        for k in sorted(moved):
+            w(f"  {k:<42} {_fmt_val(moved[k])}\n")
+
+    hists = bundle.get("histograms") or {}
+    live = {k: s for k, s in hists.items() if s.get("count")}
+    if live:
+        w(f"\n-- histograms ({len(live)}):\n")
+        w(f"  {'name':<28}{'count':>8}{'mean':>12}{'p50':>12}"
+          f"{'p95':>12}{'p99':>12}{'max':>12}\n")
+        for k in sorted(live):
+            s = live[k]
+            w(f"  {k:<28}{s['count']:>8}"
+              + "".join(f"{_fmt_val(s[f]):>12}"
+                        for f in ("mean", "p50", "p95", "p99", "max"))
+              + "\n")
+
+    events = bundle.get("events") or []
+    shown = events if not max_events else events[-max_events:]
+    w(f"\n-- events (last {len(shown)} of {len(events)}):\n")
+    t_end = events[-1]["ts_ns"] if events else 0
+    for ev in shown:
+        rel_ms = (ev["ts_ns"] - t_end) / 1e6
+        fields = {k: v for k, v in ev.items() if k not in ("ts_ns", "kind")}
+        detail = " ".join(f"{k}={_fmt_val(v)}" for k, v in fields.items())
+        w(f"  {rel_ms:>10.1f}ms  {ev['kind']:<20} {detail}\n")
+    return bundle
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="bundle file(s) or a flight dump directory "
+                         "(default: this process's default dump dir)")
+    ap.add_argument("--events", type=int, default=40,
+                    help="event-tail length to show (0 = all)")
+    ap.add_argument("--raw", action="store_true",
+                    help="re-emit the bundle as indented JSON")
+    args = ap.parse_args(argv)
+    targets = args.paths or [_default_dir()]
+    bundles = [b for t in targets for b in _find_bundles(t)]
+    for i, b in enumerate(bundles):
+        if i:
+            sys.stdout.write("\n")
+        render(b, max_events=args.events, raw=args.raw)
+
+
+if __name__ == "__main__":
+    main()

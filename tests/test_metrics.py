@@ -1,0 +1,448 @@
+"""Telemetry subsystem (profiler.metrics / profiler.flight).
+
+The four contracts from the observability tentpole:
+
+  * histogram math — the shared log2-bucket layout gives exact
+    count/sum/min/max, percentiles with bounded (<=2x) relative error,
+    and element-wise mergeability (thread/replica histograms combine into
+    the same numbers as one histogram fed everything);
+  * zero-sync train metrics — ``CompiledTrainStep(metrics=...)``
+    accumulates device scalars inside the donated carry and harvests them
+    only at sync boundaries: metrics ON adds zero ``jit.syncs`` /
+    ``jit.traces`` / extra dispatches to a steady loop (the same gate
+    ``scripts/check_counters.py`` enforces end-to-end);
+  * concurrency — counters, the global histogram registry and host-tracer
+    spans stay exact under concurrent writer threads;
+  * flight recorder — faults leave a postmortem bundle; a killed fleet
+    replica's dump names its in-flight request ids (THE chaos hook).
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as pjit
+import paddle_tpu.nn as nn
+from paddle_tpu.core import flags as core_flags
+from paddle_tpu.profiler import counters, flight, host_tracer, metrics
+from paddle_tpu.profiler.metrics import Histogram, MetricsLogger
+
+
+@pytest.fixture(autouse=True)
+def _restore_trace_flags():
+    level = core_flags.flag("FLAGS_host_trace_level")
+    yield
+    core_flags.set_flags({"FLAGS_host_trace_level": level})
+    if host_tracer.is_collecting():
+        host_tracer.stop()
+
+
+class TestHistogram:
+    def test_exact_stats(self):
+        h = Histogram("t", "ns")
+        for v in (1.0, 2.0, 4.0, 8.0):
+            h.record(v)
+        assert h.count == 4
+        assert h.sum == 15.0
+        assert h.min == 1.0 and h.max == 8.0
+        assert h.mean == pytest.approx(3.75)
+
+    def test_single_value_percentiles_exact(self):
+        for v in (1.0, 3.7, 1e6, 123456.0):
+            h = Histogram()
+            h.record(v)
+            s = h.summary()
+            assert s["p50"] == s["p95"] == s["p99"] == v
+
+    def test_percentile_bounded_relative_error(self):
+        import math
+        rng = np.random.RandomState(0)
+        vals = np.sort(rng.lognormal(mean=12.0, sigma=2.0, size=2000))
+        h = Histogram()
+        for v in vals:
+            h.record(v)
+        for q in (50, 95, 99):
+            got = h.percentile(q)
+            # nearest-rank reference: the exact order statistic the
+            # bucket walk targets; log2 buckets bound the answer to the
+            # bucket holding it, whose geometric midpoint is within
+            # sqrt(2)x of any member
+            true = float(vals[max(1, math.ceil(q / 100 * len(vals))) - 1])
+            assert true / 2 <= got <= true * 2, (q, got, true)
+        s = h.summary()
+        assert s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+        assert s["min"] <= s["p50"]
+
+    def test_merge_matches_single_histogram(self):
+        rng = np.random.RandomState(1)
+        a, b = rng.uniform(1, 1e6, 50), rng.uniform(1e3, 1e9, 70)
+        h1, h2, ref = Histogram("m"), Histogram("m"), Histogram("m")
+        for v in a:
+            h1.record(v)
+            ref.record(v)
+        for v in b:
+            h2.record(v)
+            ref.record(v)
+        h1.merge(h2)
+        assert h1.summary() == ref.summary()
+
+    def test_empty_summary_is_zeros(self):
+        s = Histogram().summary()
+        assert s == {"count": 0, "sum": 0.0, "mean": 0.0, "min": 0.0,
+                     "max": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+        assert Histogram().percentile(99) == 0.0
+
+    def test_zero_and_negative_absorbed_by_bucket_zero(self):
+        h = Histogram()
+        h.record(0.0)
+        h.record(-5.0)
+        assert h.count == 2
+        assert h.min == -5.0 and h.max == 0.0
+        # percentiles clamp to the observed range, never invent positives
+        assert h.percentile(50) <= 0.0
+
+    def test_to_dict_from_dict_roundtrip_and_merge(self):
+        h = Histogram("serving.ttft_ns", "ns")
+        for v in (10.0, 1e6, 3e6, 5e9):
+            h.record(v)
+        d = json.loads(json.dumps(h.to_dict()))  # wire-format safe
+        back = Histogram.from_dict(d)
+        assert back.name == h.name and back.unit == h.unit
+        assert back.summary() == h.summary()
+        # a deserialized histogram still merges element-wise
+        ref = h.copy().merge(h)
+        assert back.merge(h).summary() == ref.summary()
+
+    def test_copy_is_independent(self):
+        h = Histogram()
+        h.record(1.0)
+        c = h.copy()
+        h.record(100.0)
+        assert c.count == 1 and h.count == 2
+
+
+class TestRegistry:
+    def test_get_histogram_is_singleton(self):
+        a = metrics.get_histogram("test.reg.one", "ns")
+        b = metrics.get_histogram("test.reg.one")
+        assert a is b
+
+    def test_observe_sum_counter_feeds_legacy_counter(self):
+        before = counters.snapshot()
+        metrics.observe("test.reg.lat_ns", 1000, unit="ns", sum_counter=True)
+        metrics.observe("test.reg.lat_ns", 2500, unit="ns", sum_counter=True)
+        d = counters.delta(before)
+        assert d.get("test.reg.lat_ns") == 3500
+        h = metrics.get_histogram("test.reg.lat_ns")
+        assert h.count >= 2 and h.sum >= 3500
+
+    def test_observe_extra_records_caller_scoped(self):
+        local = Histogram("test.reg.extra", "ns")
+        metrics.observe("test.reg.extra", 42.0, extra=local)
+        assert local.count == 1 and local.sum == 42.0
+        assert metrics.get_histogram("test.reg.extra").count >= 1
+
+    def test_histogram_summaries_skips_empty(self):
+        metrics.get_histogram("test.reg.never_recorded")
+        metrics.observe("test.reg.recorded", 7.0)
+        s = metrics.histogram_summaries()
+        assert "test.reg.never_recorded" not in s
+        assert s["test.reg.recorded"]["count"] >= 1
+
+
+class TestMetricsLogger:
+    def test_jsonl_schema_series_and_summary(self, tmp_path):
+        path = tmp_path / "train.jsonl"
+        with MetricsLogger(path, run="r0") as log:
+            log.log(step=1, loss=2.5, lr=1e-4)
+            log.log(step=2, loss=2.0, lr=1e-4, grad_norm=0.7)
+            log.log(step=3, loss=1.5, lr=1e-4, mfu=None)  # None dropped
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert len(lines) == 3
+        for rec, step in zip(lines, (1, 2, 3)):
+            assert rec["run"] == "r0" and rec["step"] == step
+            assert isinstance(rec["ts"], float) and "loss" in rec
+        assert "mfu" not in lines[2]
+        assert log.series("loss") == [(1, 2.5), (2, 2.0), (3, 1.5)]
+        assert log.latest("loss") == 1.5
+        assert log.latest("absent", default=-1) == -1
+        assert log.names() == ["grad_norm", "loss", "lr"]
+        s = log.summary()
+        assert s["loss"] == {"count": 3, "last": 1.5, "mean": 2.0,
+                             "min": 1.5, "max": 2.5}
+        assert s["grad_norm"]["count"] == 1
+
+    def test_memory_only_logger(self):
+        log = MetricsLogger()
+        log.log(step=0, loss=1.0)
+        assert log.path is None and log.latest("loss") == 1.0
+
+    def test_prometheus_text_exposition(self):
+        counters.inc("test.prom.counter", 3)
+        metrics.observe("test.prom.hist_ns", 1e6, unit="ns")
+        log = MetricsLogger()
+        log.log(step=5, loss=1.25)
+        text = metrics.prometheus_text(log)
+        assert "# TYPE ptpu_test_prom_counter counter" in text
+        assert "ptpu_test_prom_counter 3" in text
+        assert "# TYPE ptpu_test_prom_hist_ns summary" in text
+        assert 'ptpu_test_prom_hist_ns{quantile="0.5"}' in text
+        assert "ptpu_test_prom_hist_ns_count 1" in text
+        assert "# TYPE ptpu_metric_loss gauge" in text
+        assert "ptpu_metric_loss 1.25" in text
+
+
+class TestConcurrency:
+    N_THREADS, N_ITERS = 8, 400
+
+    def test_counters_and_histograms_exact_under_threads(self):
+        before = counters.snapshot()
+        local = Histogram("test.conc.local")
+
+        def worker(tid):
+            for i in range(self.N_ITERS):
+                counters.inc("test.conc.total")
+                metrics.observe("test.conc.lat_ns", i + 1,
+                                sum_counter=True, extra=local)
+
+        ts = [threading.Thread(target=worker, args=(t,))
+              for t in range(self.N_THREADS)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        n = self.N_THREADS * self.N_ITERS
+        per_thread_sum = self.N_ITERS * (self.N_ITERS + 1) // 2
+        d = counters.delta(before)
+        assert d.get("test.conc.total") == n
+        assert d.get("test.conc.lat_ns") == self.N_THREADS * per_thread_sum
+        assert local.count == n
+        assert local.sum == self.N_THREADS * per_thread_sum
+        assert local.min == 1.0 and local.max == self.N_ITERS
+        g = metrics.get_histogram("test.conc.lat_ns")
+        assert g.count == n
+
+    def test_tracer_spans_and_counters_concurrent(self):
+        core_flags.set_flags({"FLAGS_host_trace_level": 1})
+        host_tracer.start()
+        before = counters.snapshot()
+
+        barrier = threading.Barrier(4)  # overlap: tids stay distinct
+
+        def worker():
+            barrier.wait()
+            for _ in range(50):
+                with host_tracer.span("conc_span"):
+                    counters.inc("test.conc.spans")
+
+        try:
+            ts = [threading.Thread(target=worker) for _ in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        finally:
+            evts = host_tracer.stop()
+        spans = [e for e in evts if e[0] == "conc_span"]
+        assert len(spans) == 200
+        assert len({e[1] for e in spans}) == 4      # one tid per thread
+        assert counters.delta(before).get("test.conc.spans") == 200
+
+
+class TestFlightRecorder:
+    def test_ring_capacity_and_dump_schema(self, tmp_path):
+        flight.configure(directory=tmp_path, capacity=4)
+        try:
+            flight.clear()
+            for i in range(6):
+                flight.record("test.ev", i=i)
+            evs = flight.events()
+            assert len(evs) == 4                    # ring dropped oldest
+            assert [f["i"] for _, _, f in evs] == [2, 3, 4, 5]
+            counters.inc("test.flight.moved", 9)
+            metrics.observe("test.flight.hist", 3.0)
+            before = counters.snapshot()
+            path = flight.dump("unit_test", {"answer": 42})
+            assert flight.last_dump_path() == path
+            d = counters.delta(before)
+            assert d.get("flight.dumps") == 1
+            assert d.get("flight.dumps.unit_test") == 1
+            b = flight.load(path)
+            assert b["reason"] == "unit_test"
+            assert b["context"] == {"answer": 42}
+            assert b["counters_delta"].get("test.flight.moved") == 9
+            assert b["histograms"]["test.flight.hist"]["count"] >= 1
+            assert [e["kind"] for e in b["events"]] == ["test.ev"] * 4
+            assert all("ts_ns" in e for e in b["events"])
+        finally:
+            flight.configure(directory="", capacity=flight._DEFAULT_CAPACITY)
+            flight.clear()
+
+    def test_record_point_feeds_ring(self):
+        flight.clear()
+        flight.record_point("loss", 2.5, step=7)
+        ts, kind, fields = flight.events()[-1]
+        assert kind == "metric"
+        assert fields == {"name": "loss", "value": 2.5, "step": 7}
+
+
+def _tiny_step(metrics_arg, fused_steps=1):
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+
+    def loss_fn(m, a, b):
+        return ((m(a) - b) ** 2).mean()
+
+    step = pjit.CompiledTrainStep(net, loss_fn, opt,
+                                  fused_steps=fused_steps,
+                                  metrics=metrics_arg)
+    x = paddle.randn([4, 8])
+    y = paddle.randn([4, 4])
+    return step, x, y
+
+
+class TestTrainStepMetrics:
+    def test_metrics_on_adds_zero_syncs_or_traces(self, tmp_path):
+        log = MetricsLogger(tmp_path / "t.jsonl")
+        step, x, y = _tiny_step(log)
+        step(x, y)                     # warm: hydrate + compile
+        step(x, y)                     # accumulator-structure retrace
+        step.metrics_flush()
+        before = counters.snapshot()
+        step(x, y)
+        step(x, y)
+        step.metrics_flush()           # harvest inside the steady window
+        d = counters.delta(before)
+        assert d.get("jit.syncs", 0) == 0
+        assert d.get("jit.traces", 0) == 0
+        assert d.get("jit.hydrates", 0) == 0
+        assert d.get("jit.host.dispatches", 0) == 2
+        # the harvest delivered real per-step series anyway
+        assert len(log.series("loss")) == 4
+        assert all(np.isfinite(v) for _, v in log.series("loss"))
+        assert len(log.series("grad_norm")) == 4
+        assert log.latest("lr") == pytest.approx(1e-3)
+
+    def test_donated_accumulator_gauges(self):
+        log = MetricsLogger()
+        step, x, y = _tiny_step(log)
+        for _ in range(3):
+            step(x, y)
+        step.metrics_flush()
+        assert counters.get("train.steps_accum") == 3
+        loss_mean = counters.get("train.loss_mean")
+        series_mean = np.mean([v for _, v in log.series("loss")])
+        assert loss_mean == pytest.approx(series_mean, rel=1e-5)
+
+    def test_fused_window_per_step_records(self):
+        from paddle_tpu.io import Window
+        k = 2
+        log = MetricsLogger()
+        step, x, y = _tiny_step(log, fused_steps=k)
+        wx = paddle.to_tensor(np.stack([np.asarray(x.numpy())] * k))
+        wy = paddle.to_tensor(np.stack([np.asarray(y.numpy())] * k))
+        win = Window((wx, wy), k)
+        step(win)                      # priming single-step fallback
+        step(win)                      # scan compile
+        step.metrics_flush()
+        n0 = len(log.series("loss"))
+        before = counters.snapshot()
+        step(win)                      # steady: ONE dispatch, k records
+        step.metrics_flush()
+        d = counters.delta(before)
+        assert d.get("jit.host.dispatches", 0) == 1
+        assert d.get("jit.syncs", 0) == 0 and d.get("jit.traces", 0) == 0
+        pts = log.series("loss")[n0:]
+        assert len(pts) == k
+        steps = [s for s, _ in pts]
+        assert steps == sorted(steps) and len(set(steps)) == k
+
+    def test_sync_boundary_flushes_automatically(self):
+        log = MetricsLogger()
+        step, x, y = _tiny_step(log)
+        step(x, y)
+        step.sync()                    # existing boundary harvests pending
+        assert len(log.series("loss")) == 1
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=32,
+                    use_flash_attention=False)
+    paddle.seed(31)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _fleet(m, **kw):
+    from paddle_tpu.serving import ServingFleet
+    kw.setdefault("replicas", 2)
+    kw.setdefault("threaded", False)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("min_bucket", 4)
+    kw.setdefault("queue_size", 16)
+    kw.setdefault("heartbeat_timeout_s", 30.0)
+    return ServingFleet(m, **kw)
+
+
+class TestServingTelemetry:
+    def test_engine_latency_histograms(self, model):
+        from paddle_tpu.serving import LLMEngine
+        eng = LLMEngine(model, max_slots=2, max_seq_len=32, min_bucket=4)
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, 64, size=n).tolist() for n in (5, 3)]
+        for _ in eng.generate(prompts, max_new_tokens=4):
+            pass
+        snap = eng.histogram_snapshot()
+        assert snap["serving.ttft_ns"].count == 2       # one TTFT/request
+        assert snap["serving.itl_ns"].count == 2 * 3    # max_new-1 each
+        assert snap["serving.queue_wait_ns"].count == 2
+        assert snap["serving.prefill_occupancy"].count >= 1
+        assert 0.0 < snap["serving.decode_occupancy"].max <= 1.0
+        # snapshot copies are decoupled from the live engine histograms
+        snap["serving.ttft_ns"].record(1.0)
+        assert eng.hists["serving.ttft_ns"].count == 2
+
+    def test_fleet_chaos_dump_names_inflight_rids(self, model, tmp_path):
+        """THE chaos acceptance hook: kill a replica mid-decode and the
+        flight dump must name the killed replica and the request ids it
+        had in flight — while the fleet still finishes every request."""
+        from paddle_tpu.resilience import faultinject
+        rng = np.random.default_rng(9)
+        p0 = rng.integers(0, 64, size=5).tolist()
+        p1 = rng.integers(0, 64, size=6).tolist()
+        fleet = _fleet(model, max_slots=1, warm_buckets=(5,))
+        flight.configure(directory=tmp_path)
+        flight.clear()
+        try:
+            h0 = fleet.submit(p0, max_new_tokens=6)
+            h1 = fleet.submit(p1, max_new_tokens=6)
+            killed_idx = h0.replica_idx    # retry may reassign h0 later
+            with faultinject.fault_schedule(f"replica_crash@{h0.rid}"):
+                fleet.join([h0, h1], timeout_s=120)
+            assert h0.finish_reason == "length"
+            assert h1.finish_reason == "length"
+            path = flight.last_dump_path()
+            assert path is not None, "replica death left no flight dump"
+            b = flight.load(path)
+            assert b["reason"] == "replica_died"
+            ctx = b["context"]
+            assert ctx["reason"] == "crash"
+            assert ctx["replica"] == killed_idx
+            assert h0.rid in ctx["fleet_rids"]
+            assert ctx["in_flight_rids"], "dump lost the in-flight set"
+            # fleet-wide latency aggregation still sees both requests
+            lat = fleet.stats()["latency"]
+            assert lat["serving.ttft_ns"]["count"] >= 2
+        finally:
+            flight.configure(directory="")
+            flight.clear()
+            fleet.drain()
