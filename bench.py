@@ -25,6 +25,14 @@ Legs (perf round 5):
   ``GPT.generate`` — reports decode tokens/s for both, ``serve_speedup``,
   and TTFT / inter-token / queue-wait latency percentiles
   (p50/p95/p99 in ms) from the engine's mergeable histograms.
+- gpt125m_paged (paged-KV leg): the serving workload through
+  ``LLMEngine(kv_layout="paged")`` — a mixed-length request set against
+  the legacy slot arena at the SAME KV HBM budget (the block pool is
+  sized to the slot arena's token capacity), gating ≥2× peak admitted
+  concurrent requests; plus a 64-request shared-system-prompt workload
+  reporting TTFT p50/p95 and gating prefix-cache hits with strictly
+  fewer prefill-chunk launches than a no-cache twin; decode tok/s
+  parity vs the slot engine is reported informationally.
 - gpt125m_fleet (elastic-fleet leg): the same seeded request set through
   a 2-replica ``serving.ServingFleet`` clean, then with one replica
   killed mid-decode (``faultinject`` ``replica_crash``) — reports decode
@@ -46,8 +54,8 @@ accumulators); the serve and fleet legs embed TTFT / inter-token /
 queue-wait percentiles; the ckpt leg embeds save-latency percentiles;
 the mesh legs embed per-compiled-program HBM bytes ("hbm") captured via
 XLA memory analysis under FLAGS_device_telemetry.
-Set PTPU_BENCH=125m|760m|serve|ckpt|fleet|mesh|mesh760m to run a single
-leg.  PTPU_FUSED_STEPS sets the fused window length K (default 4; 1
+Set PTPU_BENCH=125m|760m|serve|paged|ckpt|fleet|mesh|mesh760m to run a
+single leg.  PTPU_FUSED_STEPS sets the fused window length K (default 4; 1
 disables the fused leg).  PTPU_MESH picks the mesh leg's axis degrees.
 """
 
@@ -317,6 +325,191 @@ def _run_serve_leg(cfg, n_requests=64, max_new=64, max_slots=8,
             "serving leg: engine output diverged from sequential "
             "GPT.generate")
     del eng, model
+    return leg
+
+
+def _run_paged_leg(cfg, n_requests=64, max_new=64, max_slots=8,
+                   min_bucket=8, block_size=16, prefill_chunk=256,
+                   n_verify=8, seed=0):
+    """Paged KV cache vs the legacy slot arena at the SAME KV HBM budget.
+
+    Leg 1 (capacity): a mixed-length request set served by the slot
+    engine (``max_slots`` rows of ``S_max``) and by a paged engine whose
+    block pool holds exactly the slot arena's token capacity
+    (``max_slots * ceil(S/bs)`` blocks).  Because paged requests reserve
+    only the blocks they can actually touch, the pool admits several
+    requests per slot-arena-row-equivalent — gated at ≥2× peak
+    concurrent admitted requests.  The first ``n_verify`` requests are
+    verified token-identical to sequential ``GPT.generate`` on both
+    engines, and decode tok/s parity is reported.
+
+    Leg 2 (shared prefix): ``n_requests`` prompts sharing one
+    system-prompt prefix, served sequentially enough to feed the prefix
+    tree — reports TTFT p50/p95 and gates ``prefix_hits > 0`` with
+    strictly fewer prefill-chunk launches than a no-cache twin."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.profiler import counters
+    from paddle_tpu.serving import LLMEngine
+    from paddle_tpu.serving.engine import bucket_length
+    from paddle_tpu.serving.kvcache import blocks_for_tokens
+
+    paddle.seed(seed)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(seed)
+    S = cfg.max_seq_len
+    n_verify = min(n_verify, n_requests)
+    lo = max(2, S // 16)
+    hi = max(lo + 1, S // 4 - max_new)
+    lens = [int(rng.randint(lo, hi)) for _ in range(n_requests)]
+    prompts = [rng.randint(0, cfg.vocab_size, size=n).tolist()
+               for n in lens]
+    refs = [np.asarray(model.generate(
+        paddle.to_tensor(np.asarray([p])),
+        max_new_tokens=max_new).numpy())[0] for p in prompts[:n_verify]]
+
+    def serve(eng, ps):
+        hs = [eng.add_request(p, max_new_tokens=max_new) for p in ps]
+        peak = 0
+        while not all(h.is_finished for h in hs):
+            eng.step()
+            peak = max(peak, eng.stats()["active"])
+        return hs, peak
+
+    # legacy slot arena: KV HBM = L x max_slots x S_max
+    slot_eng = LLMEngine(model, max_slots=max_slots, max_seq_len=S,
+                         min_bucket=min_bucket)
+    warm = [rng.randint(0, cfg.vocab_size, size=min(b, S - 3)).tolist()
+            for b in sorted({bucket_length(n, min_bucket, S)
+                             for n in lens})]
+    for _ in slot_eng.generate(warm, max_new_tokens=2):
+        pass
+    t0 = time.perf_counter()
+    shs, slot_peak = serve(slot_eng, prompts)
+    slot_s = time.perf_counter() - t0
+    slot_tps = n_requests * max_new / max(slot_s, 1e-9)
+    for h, r in zip(shs[:n_verify], refs):
+        if not np.array_equal(h.output_ids(), r):
+            raise AssertionError(
+                "paged leg: slot-engine output diverged from generate")
+    del slot_eng
+
+    # paged twin at the SAME KV HBM: pool == the slot arena's tokens;
+    # scheduling slots are host-side bookkeeping, so the admitted
+    # concurrency is bounded by memory, not by rows
+    n_blocks = max_slots * blocks_for_tokens(S, block_size) + 1
+    peng = LLMEngine(model, max_slots=4 * max_slots, max_seq_len=S,
+                     min_bucket=min_bucket, kv_layout="paged",
+                     block_size=block_size, n_blocks=n_blocks,
+                     prefill_chunk=prefill_chunk)
+    # warm one request per power-of-two chunk bucket (+ the decode)
+    b, pwarm = min_bucket, []
+    while b <= peng.prefill_chunk:
+        pwarm.append(rng.randint(0, cfg.vocab_size,
+                                 size=min(b, S - 3)).tolist())
+        b *= 2
+    for _ in peng.generate(pwarm, max_new_tokens=2):
+        pass
+    pbefore = counters.snapshot()
+    t0 = time.perf_counter()
+    phs, paged_peak = serve(peng, prompts)
+    paged_s = time.perf_counter() - t0
+    pdelta = counters.delta(pbefore)
+    paged_tps = n_requests * max_new / max(paged_s, 1e-9)
+    for h, r in zip(phs[:n_verify], refs):
+        if not np.array_equal(h.output_ids(), r):
+            raise AssertionError(
+                "paged leg: paged-engine output diverged from generate")
+    capacity_ratio = paged_peak / max(1, slot_peak)
+    if capacity_ratio < 2.0:
+        raise AssertionError(
+            f"paged leg: peak concurrency {paged_peak} vs slot "
+            f"{slot_peak} = {capacity_ratio:.2f}x at the same KV HBM "
+            "(want >= 2x)")
+
+    # shared-system-prompt workload: TTFT tail + prefix-cache economics.
+    # The first request prefills the system prompt; it is finished (and
+    # donated to the tree) before the rest arrive, so every later
+    # request shares the cached prefix.
+    bs = block_size
+    sys_len = max(bs, (S // 4 // bs) * bs)
+    tail_len = max(2, min(bs, S - sys_len - max_new - 2))
+    sysp = rng.randint(0, cfg.vocab_size, size=sys_len).tolist()
+    shared = [sysp + rng.randint(0, cfg.vocab_size,
+                                 size=tail_len).tolist()
+              for _ in range(n_requests)]
+
+    def serve_shared(eng):
+        h0 = eng.add_request(shared[0], max_new_tokens=max_new)
+        while not h0.is_finished:
+            eng.step()
+        hs = [eng.add_request(p, max_new_tokens=max_new)
+              for p in shared[1:]]
+        while not all(h.is_finished for h in hs):
+            eng.step()
+
+    nc_eng = LLMEngine(model, max_slots=4 * max_slots, max_seq_len=S,
+                       min_bucket=min_bucket, kv_layout="paged",
+                       block_size=block_size, n_blocks=n_blocks,
+                       prefill_chunk=prefill_chunk, prefix_cache=False)
+    ncbefore = counters.snapshot()
+    serve_shared(nc_eng)
+    nc_chunks = counters.delta(ncbefore).get("serving.kv.prefill_chunks",
+                                             0)
+    del nc_eng
+    pc_eng = LLMEngine(model, max_slots=4 * max_slots, max_seq_len=S,
+                       min_bucket=min_bucket, kv_layout="paged",
+                       block_size=block_size, n_blocks=n_blocks,
+                       prefill_chunk=prefill_chunk)
+    pcbefore = counters.snapshot()
+    t0 = time.perf_counter()
+    serve_shared(pc_eng)
+    shared_s = time.perf_counter() - t0
+    pcdelta = counters.delta(pcbefore)
+    pc_chunks = pcdelta.get("serving.kv.prefill_chunks", 0)
+    pc_hits = pcdelta.get("serving.kv.prefix_hits", 0)
+    if pc_hits < n_requests - 1:
+        raise AssertionError(
+            f"paged leg: shared-prefix workload scored {pc_hits} "
+            f"prefix hits (want >= {n_requests - 1})")
+    if not pc_chunks < nc_chunks:
+        raise AssertionError(
+            f"paged leg: prefix cache launched {pc_chunks} prefill "
+            f"chunks vs {nc_chunks} without (want strictly fewer)")
+    snap = pc_eng.histogram_snapshot()
+    pstats = pc_eng.stats()
+    leg = {"requests": n_requests,
+           "max_new_tokens": max_new,
+           "block_size": block_size,
+           "n_blocks": n_blocks,
+           "prefill_chunk": peng.prefill_chunk,
+           "kv_hbm_slots_equiv": max_slots,
+           "peak_concurrent_slot": slot_peak,
+           "peak_concurrent_paged": paged_peak,
+           "capacity_ratio": round(capacity_ratio, 3),
+           "decode_tokens_per_sec_slot": round(slot_tps, 2),
+           "decode_tokens_per_sec_paged": round(paged_tps, 2),
+           "decode_parity": round(paged_tps / max(slot_tps, 1e-9), 4),
+           "steady_retraces": pdelta.get("serving.retraces", 0),
+           "outputs_match_generate": True,
+           "shared_prefix": {
+               "requests": n_requests,
+               "system_prompt_tokens": sys_len,
+               "prefix_hits": pc_hits,
+               "prefix_hit_tokens": pcdelta.get(
+                   "serving.kv.prefix_hit_tokens", 0),
+               "prefill_chunks": pc_chunks,
+               "prefill_chunks_nocache": nc_chunks,
+               "wall_s": round(shared_s, 3),
+               "ttft": _latency_ms(snap["serving.ttft_ns"]),
+               "itl": _latency_ms(snap["serving.itl_ns"]),
+               "block_occupancy_p95": round(
+                   snap["serving.kv.block_occupancy"].percentile(95),
+                   4)},
+           "blocks_evicted": pstats["blocks_evicted"],
+           "cow_copies": pstats["cow_copies"]}
+    del peng, pc_eng, model
     return leg
 
 
@@ -613,6 +806,12 @@ def main():
         # budget (overhead number is informational on CPU)
         out["ckpt"] = _run_ckpt_leg(cfg, 2, 128, 4,
                                     fused_steps=max(1, fused_k))
+        # tiny paged-KV leg: capacity / prefix-cache / identity gates
+        # always; throughput numbers informational on CPU
+        out["paged"] = _run_paged_leg(cfg, n_requests=24, max_new=8,
+                                      max_slots=4, min_bucket=4,
+                                      block_size=4, prefill_chunk=16,
+                                      n_verify=4)
         # tiny fleet leg: durability gates (zero lost, respawn == kills,
         # churn output identical) always; throughput informational on CPU
         out["fleet"] = _run_fleet_leg(cfg, replicas=2, n_requests=4,
@@ -630,11 +829,11 @@ def main():
         return
 
     which = os.environ.get("PTPU_BENCH", "all")
-    if which not in ("all", "760m", "125m", "serve", "ckpt", "fleet",
-                     "mesh", "mesh760m"):
+    if which not in ("all", "760m", "125m", "serve", "paged", "ckpt",
+                     "fleet", "mesh", "mesh760m"):
         raise SystemExit(
             f"PTPU_BENCH={which!r}: expected "
-            f"all|760m|125m|serve|ckpt|fleet|mesh|mesh760m")
+            f"all|760m|125m|serve|paged|ckpt|fleet|mesh|mesh760m")
     mesh_degrees = _parse_mesh_degrees(os.environ.get("PTPU_MESH", "dp2"))
     mesh_ndev = int(np.prod(list(mesh_degrees.values())))
     legs = {}
@@ -697,6 +896,18 @@ def main():
                                    recompute=None)
         legs["gpt125m_serve"] = _run_serve_leg(scfg, n_requests=64,
                                                max_new=64, max_slots=8)
+    if which in ("all", "paged"):
+        # paged-KV leg: >=2x admitted concurrency at the slot arena's KV
+        # HBM on mixed lengths, plus shared-system-prompt TTFT tails and
+        # the prefix-cache hit / reduced-prefill gates
+        pcfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
+                                   dtype="bfloat16",
+                                   use_flash_attention=False,
+                                   recompute=None)
+        legs["gpt125m_paged"] = _run_paged_leg(pcfg, n_requests=64,
+                                               max_new=64, max_slots=8,
+                                               block_size=16,
+                                               prefill_chunk=256)
     if which in ("all", "fleet"):
         # elastic-fleet leg: multi-replica throughput with and without
         # one replica killed mid-decode (acceptance: zero lost requests,
@@ -752,6 +963,16 @@ def main():
             "value": leg["decode_tokens_per_sec"],
             "unit": "tokens/s",
             "vs_baseline": leg["churn_retention"],  # vs one replica killed
+            "legs": legs,
+        }))
+        return
+    if set(legs) == {"gpt125m_paged"}:  # paged-only run: capacity line
+        leg = legs["gpt125m_paged"]
+        print(json.dumps({
+            "metric": "gpt125m_paged_decode_tokens_per_sec",
+            "value": leg["decode_tokens_per_sec_paged"],
+            "unit": "tokens/s",
+            "vs_baseline": leg["capacity_ratio"],  # peak admits vs slots
             "legs": legs,
         }))
         return

@@ -620,6 +620,176 @@ class GPTForCausalLM(Layer):
                             h[:, 0])
         return logits, cache_k, cache_v
 
+    def prefill_paged(self, w, ids, start, length, bt, pool_k, pool_v):
+        """One chunked-prefill step over a block-pool KV arena (the paged
+        twin of ``prefill_slot``; see ``serving.paged``).
+
+        ``ids[1, C]`` is one right-padded prompt chunk of true length
+        ``length`` (traced scalar) whose tokens sit at logical positions
+        ``[start, start + length)``; ``bt[max_blocks]`` is the request's
+        int32 block table (an OPERAND — the program shape depends only
+        on the chunk bucket ``C``); ``pool_k``/``pool_v`` are the shared
+        donated pool ``[L, n_blocks, bs, nh, hd]``.  Each chunk token's
+        K/V is scattered into block ``bt[(start+i) // bs]`` at offset
+        ``(start+i) % bs``; padded tail tokens are zeroed and routed to
+        the trash block 0.  Attention gathers the row's whole logical
+        sequence ``bt -> [max_blocks*bs, nh, hd]`` AFTER the scatter, so
+        one masked ``kpos <= qpos`` einsum covers the cached prefix
+        (earlier chunks, shared prefix blocks) and the chunk itself.
+        Returns ``(pool_k, pool_v, logits[1, V])`` with the fp32 logits
+        read at the chunk's last valid token — the first-token sample
+        point when this is the final chunk."""
+        c = self.config
+        nh = c.num_heads
+        eps = c.layer_norm_epsilon
+        H = c.hidden_size
+        hd = H // nh
+        B, C = ids.shape
+        n_blocks, bs = pool_k.shape[1], pool_k.shape[2]
+        max_blocks = bt.shape[0]
+        S = max_blocks * bs
+        scale = 1.0 / math.sqrt(hd)
+        h = self._embed(c, w["wte"], w["wpe"], ids, start)
+        valid = jnp.arange(C) < length
+        tokpos = start + jnp.arange(C)
+        # padded tokens scatter (zeroed) into the trash block 0
+        blk = jnp.where(valid, bt[tokpos // bs], 0)
+        off = tokpos % bs
+        kpos = jnp.arange(S)
+        qpos = start + jnp.arange(C)
+        mask = kpos[None, :] <= qpos[:, None]              # [C, S]
+
+        def body(hh, xs):
+            lw, ck, cv = xs
+            x = _norm(hh, lw["ln1_w"], lw["ln1_b"], eps)
+            qkv = jnp.matmul(x, lw["qkv_w"], precision=matmul_precision()) \
+                + lw["qkv_b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, C, nh, hd)
+            k = k.reshape(B, C, nh, hd)
+            v = v.reshape(B, C, nh, hd)
+            if c.use_rope:
+                from ..kernels.rope import apply_rope
+                q = apply_rope(q, offset=start)
+                k = apply_rope(k, offset=start)
+            vm = valid[:, None, None]
+            kz = jnp.where(vm, k[0].astype(ck.dtype), 0)
+            vz = jnp.where(vm, v[0].astype(cv.dtype), 0)
+            ck = ck.at[blk, off].set(kz)
+            cv = cv.at[blk, off].set(vz)
+            # gather AFTER the scatter: the logical view holds the shared
+            # prefix, earlier chunks, and this chunk's own K/V
+            gk = ck[bt].reshape(S, nh, hd)[None]
+            gv = cv[bt].reshape(S, nh, hd)[None]
+            logits = jnp.einsum("bqhd,bkhd->bhqk",
+                                (q * scale).astype(jnp.float32),
+                                gk.astype(jnp.float32))
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            p = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(gv.dtype), gv)
+            o = o.reshape(B, C, H)
+            a = jnp.matmul(o, lw["proj_w"], precision=matmul_precision()) \
+                + lw["proj_b"]
+            hh = hh + a
+            x = _norm(hh, lw["ln2_w"], lw["ln2_b"], eps)
+            if c.num_experts > 0:
+                from ..incubate.moe import moe_ffn
+                f, _aux = moe_ffn(
+                    x, lw["gate_w"], lw["fc1_w"], lw["fc1_b"],
+                    lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
+                    capacity_factor=c.moe_capacity_factor)
+            else:
+                up = jnp.matmul(x, lw["fc1_w"],
+                                precision=matmul_precision()) + lw["fc1_b"]
+                f = jnp.matmul(jax.nn.gelu(up), lw["fc2_w"],
+                               precision=matmul_precision()) + lw["fc2_b"]
+            return hh + f, (ck, cv)
+
+        h, (pool_k, pool_v) = jax.lax.scan(body, h,
+                                           (w["lws"], pool_k, pool_v))
+        h_last = jax.lax.dynamic_slice_in_dim(h, length - 1, 1, axis=1)
+        logits = _lm_logits(c, w["wte"], w["lnf_w"], w["lnf_b"], w["head"],
+                            h_last[:, 0])
+        return pool_k, pool_v, logits
+
+    def decode_paged(self, w, tok, pos, bt, pool_k, pool_v):
+        """One decode step for B slot rows over the block-pool arena (the
+        paged twin of ``decode_slots`` — identical math, the arena row is
+        replaced by a block-table gather).
+
+        tok ``[B]`` int32, pos ``[B]`` int32, bt ``[B, max_blocks]``
+        int32 block tables (operands: the ONE compiled decode program
+        serves every block-table content), pool_k/pool_v ``[L, n_blocks,
+        bs, nh, hd]``.  Each row writes its K/V into block
+        ``bt[row, pos // bs]`` at offset ``pos % bs`` (rows with nothing
+        to write are tabled to the trash block 0 by the engine) and
+        attends over its gathered logical sequence with ``kpos <=
+        pos[row]``.  Returns ``(logits [B, V] fp32, pool_k, pool_v)``."""
+        c = self.config
+        nh = c.num_heads
+        eps = c.layer_norm_epsilon
+        H = c.hidden_size
+        hd = H // nh
+        B = tok.shape[0]
+        n_blocks, bs = pool_k.shape[1], pool_k.shape[2]
+        max_blocks = bt.shape[1]
+        S = max_blocks * bs
+        scale = 1.0 / math.sqrt(hd)
+        h = jnp.take(w["wte"], tok, axis=0)[:, None, :]
+        if w["wpe"] is not None:
+            h = h + jnp.take(w["wpe"], pos, axis=0)[:, None, :]
+        kpos = jnp.arange(S)
+        mask = kpos[None, :] <= pos[:, None]                     # [B, S]
+        rows = jnp.arange(B)
+        blk = bt[rows, pos // bs]                                # [B]
+        off = pos % bs
+
+        def body(hh, xs):
+            lw, ck, cv = xs
+            x = _norm(hh, lw["ln1_w"], lw["ln1_b"], eps)
+            qkv = jnp.matmul(x, lw["qkv_w"], precision=matmul_precision()) \
+                + lw["qkv_b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, 1, nh, hd)
+            k = k.reshape(B, 1, nh, hd)
+            v = v.reshape(B, 1, nh, hd)
+            if c.use_rope:
+                q = _rope_rows(q, pos)
+                k = _rope_rows(k, pos)
+            ck = ck.at[blk, off].set(k[:, 0].astype(ck.dtype))
+            cv = cv.at[blk, off].set(v[:, 0].astype(cv.dtype))
+            gk = ck[bt].reshape(B, S, nh, hd)
+            gv = cv[bt].reshape(B, S, nh, hd)
+            logits = jnp.einsum("bqhd,bkhd->bhqk",
+                                (q * scale).astype(jnp.float32),
+                                gk.astype(jnp.float32))
+            logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+            p = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(gv.dtype), gv)
+            o = o.reshape(B, 1, H)
+            a = jnp.matmul(o, lw["proj_w"], precision=matmul_precision()) \
+                + lw["proj_b"]
+            hh = hh + a
+            x = _norm(hh, lw["ln2_w"], lw["ln2_b"], eps)
+            if c.num_experts > 0:
+                from ..incubate.moe import moe_ffn
+                f, _aux = moe_ffn(
+                    x, lw["gate_w"], lw["fc1_w"], lw["fc1_b"],
+                    lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
+                    capacity_factor=c.moe_capacity_factor)
+            else:
+                up = jnp.matmul(x, lw["fc1_w"],
+                                precision=matmul_precision()) + lw["fc1_b"]
+                f = jnp.matmul(jax.nn.gelu(up), lw["fc2_w"],
+                               precision=matmul_precision()) + lw["fc2_b"]
+            return hh + f, (ck, cv)
+
+        h, (pool_k, pool_v) = jax.lax.scan(
+            body, h, (w["lws"], pool_k, pool_v))
+        logits = _lm_logits(c, w["wte"], w["lnf_w"], w["lnf_b"], w["head"],
+                            h[:, 0])
+        return logits, pool_k, pool_v
+
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  seed=None):

@@ -51,6 +51,13 @@ Well-known sites
 ``router_queue``     failure inside ServingFleet.submit's routing/enqueue
                      path; index = fleet request id.  Surfaced to the
                      caller as a structured ``RetryAfter`` shed.
+``kv_pool_exhausted``  deterministic paged-KV block-pool exhaustion at
+                     admission of request ``index``: the reservation is
+                     refused as if the pool were dry, the request parks
+                     at the queue head (no torn block table), and
+                     callers see ``EngineBackpressure`` once the bounded
+                     queue backs up.  Queried via :func:`take` (the
+                     engine defers rather than raises).
 ===================  ====================================================
 
 Every fired fault is appended to :data:`fired` (``(site, index)`` tuples)
@@ -107,6 +114,7 @@ _EXC = {
     "replica_crash": SimulatedCrash,
     "decode_stall": InjectedFault,   # consumed via take(); never raised
     "router_queue": InjectedFault,
+    "kv_pool_exhausted": InjectedFault,   # consumed via take(); never raised
 }
 
 _LOCK = threading.Lock()
@@ -223,7 +231,7 @@ _flags.define_flag(
     "Deterministic fault-injection schedule for resilience testing: "
     "'site@index[*count];...' with sites ckpt_write/ckpt_crash/preempt/"
     "loader/nan_loss/serving_prefill/replica_crash/decode_stall/"
-    "router_queue (see paddle_tpu.resilience.faultinject).  Empty "
-    "disables injection.")
+    "router_queue/kv_pool_exhausted (see "
+    "paddle_tpu.resilience.faultinject).  Empty disables injection.")
 _flags.register_flag_observer("FLAGS_fault_schedule",
                               lambda v: set_schedule(v or None))

@@ -12,10 +12,14 @@ notes and README "Serving" / "Elastic serving" for the API tour.
 from .engine import (EngineBackpressure, EngineClosed, LLMEngine,  # noqa: F401
                      Request, bucket_length)
 from .fleet import FleetRequest, Replica, ServingFleet  # noqa: F401
+from .kvcache import (BlockPool, BlockPoolExhausted,  # noqa: F401
+                      PrefixCache, blocks_for_tokens)
+from .paged import PagedLLMEngine  # noqa: F401
 from .router import RetryAfter, Router  # noqa: F401
 from .sampling import filter_logits, sample_tokens  # noqa: F401
 
-__all__ = ["LLMEngine", "Request", "EngineBackpressure", "EngineClosed",
-           "bucket_length", "filter_logits", "sample_tokens",
-           "ServingFleet", "FleetRequest", "Replica", "Router",
-           "RetryAfter"]
+__all__ = ["LLMEngine", "PagedLLMEngine", "Request", "EngineBackpressure",
+           "EngineClosed", "bucket_length", "filter_logits",
+           "sample_tokens", "ServingFleet", "FleetRequest", "Replica",
+           "Router", "RetryAfter", "BlockPool", "BlockPoolExhausted",
+           "PrefixCache", "blocks_for_tokens"]

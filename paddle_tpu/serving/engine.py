@@ -36,6 +36,7 @@ import itertools
 import threading
 import time
 import warnings
+import weakref
 from collections import deque
 
 import jax
@@ -52,6 +53,26 @@ from .sampling import filter_logits
 # fire on every serving step there
 warnings.filterwarnings("ignore",
                         message="Some donated buffers were not usable")
+
+# Per-model cache of the jitted serving programs.  The closures capture
+# the MODEL only (never an engine), so every engine over the same model
+# instance — fleet replicas, respawned replacements, a paged engine next
+# to a slot engine — reuses one set of XLA executables instead of
+# recompiling identical programs per engine.  Donation is per-call, and
+# jax.jit keys compiled variants by argument shape internally, so
+# sharing is invisible except in compile time (and in
+# ``serving.retraces``, which only ever counts FEWER traces).
+_MODEL_PROGRAMS = weakref.WeakKeyDictionary()
+
+
+def _model_programs(model):
+    try:
+        cache = _MODEL_PROGRAMS.get(model)
+        if cache is None:
+            cache = _MODEL_PROGRAMS[model] = {}
+    except TypeError:  # unhashable / non-weakrefable model object
+        cache = model.__dict__.setdefault("_serving_programs", {})
+    return cache
 
 
 class EngineBackpressure(RuntimeError):
@@ -164,8 +185,29 @@ class LLMEngine:
     outstanding work.
     """
 
+    def __new__(cls, *args, **kw):
+        # kv_layout="paged" routes construction to the paged subclass so
+        # `LLMEngine(model, kv_layout="paged")` is the one public spelling
+        # (serving.paged imports this module; resolve lazily)
+        if cls is LLMEngine and kw.get("kv_layout", "slots") == "paged":
+            from .paged import PagedLLMEngine
+            return super().__new__(PagedLLMEngine)
+        return super().__new__(cls)
+
     def __init__(self, model, max_slots=8, max_seq_len=None, queue_size=64,
-                 min_bucket=8, eos_token_id=None):
+                 min_bucket=8, eos_token_id=None, kv_layout="slots",
+                 block_size=16, n_blocks=None, prefill_chunk=None,
+                 prefix_cache=True):
+        if kv_layout not in ("slots", "paged"):
+            raise ValueError(f"unknown kv_layout {kv_layout!r}; "
+                             "want 'slots' or 'paged'")
+        self.kv_layout = kv_layout
+        # paged-arena knobs (used by the PagedLLMEngine _init_kv override;
+        # inert under the default slot layout)
+        self.block_size = int(block_size)
+        self.n_blocks = n_blocks
+        self.prefill_chunk = prefill_chunk
+        self.prefix_caching = bool(prefix_cache)
         c = model.config
         self.model = model
         self.config = c
@@ -184,8 +226,7 @@ class LLMEngine:
         nh = c.num_heads
         hd = c.hidden_size // nh
         dt = jnp.dtype(c.dtype)
-        self._ck = jnp.zeros((c.num_layers, B, S, nh, hd), dt)
-        self._cv = jnp.zeros((c.num_layers, B, S, nh, hd), dt)
+        self._init_kv(c, B, S, nh, hd, dt)
 
         # host mirrors of the per-slot decode inputs
         key_size = jax.random.key_data(jax.random.key(0)).shape[0]
@@ -245,8 +286,27 @@ class LLMEngine:
         ``Histogram.merge`` across replicas — the fleet Router does)."""
         return {n: h.copy() for n, h in self.hists.items()}
 
+    def _init_kv(self, c, B, S, nh, hd, dt):
+        """Allocate the device KV storage: the slot arena here, a block
+        pool in the PagedLLMEngine override."""
+        self._ck = jnp.zeros((c.num_layers, B, S, nh, hd), dt)
+        self._cv = jnp.zeros((c.num_layers, B, S, nh, hd), dt)
+
+    def release_kv(self):
+        """Drop the device KV storage (a dead replica's arena is garbage
+        — the fleet frees its HBM before respawning)."""
+        self._ck = self._cv = None
+
+    def prefix_peek(self, prompt):
+        """Tokens of ``prompt`` a prefix cache could serve without
+        prefilling — 0 under the slot layout (no sharing), overridden by
+        the paged engine.  The Router uses this for prefix-hit-aware
+        dispatch."""
+        return 0
+
     # -- compiled programs ---------------------------------------------------
-    def _first_token(self, logits, key, do_sample, temp, top_k, top_p):
+    @staticmethod
+    def _first_token(logits, key, do_sample, temp, top_k, top_p):
         """Sample the prefill's first token: identical key discipline and
         math to generate's post-prefill draw."""
         key, k0 = jax.random.split(key)
@@ -259,15 +319,21 @@ class LLMEngine:
     def _prefill_for(self, bucket):
         fn = self._prefill_jits.get(bucket)
         if fn is None:
-            def prefill(w, ids, length, key_data, do_sample, temp, top_k,
-                        top_p):
-                counters.inc("serving.retraces")  # trace-time only
-                ck, cv, logits = self.model.prefill_slot(w, ids, length)
-                tok, new_key = self._first_token(
-                    logits, jax.random.wrap_key_data(key_data),
-                    do_sample, temp, top_k, top_p)
-                return ck, cv, tok, new_key
-            fn = self._prefill_jits[bucket] = jax.jit(prefill)
+            progs = _model_programs(self.model)
+            fn = progs.get("prefill_slot")
+            if fn is None:
+                model = self.model
+
+                def prefill(w, ids, length, key_data, do_sample, temp,
+                            top_k, top_p):
+                    counters.inc("serving.retraces")  # trace-time only
+                    ck, cv, logits = model.prefill_slot(w, ids, length)
+                    tok, new_key = LLMEngine._first_token(
+                        logits, jax.random.wrap_key_data(key_data),
+                        do_sample, temp, top_k, top_p)
+                    return ck, cv, tok, new_key
+                fn = progs["prefill_slot"] = jax.jit(prefill)
+            self._prefill_jits[bucket] = fn
             counters.set_gauge("serving.prefill_programs",
                                len(self._prefill_jits))
         return fn
@@ -275,43 +341,59 @@ class LLMEngine:
     def _insert_for(self, bucket):
         fn = self._insert_jits.get(bucket)
         if fn is None:
+            progs = _model_programs(self.model)
             L = self.config.num_layers
             nh = self.config.num_heads
             hd = self.config.hidden_size // nh
             S = self.max_seq_len
-
-            def insert(ck, cv, kc, vc, slot):
-                counters.inc("serving.retraces")
-                zk = jnp.zeros((L, 1, S, nh, hd), kc.dtype)
-                zv = jnp.zeros((L, 1, S, nh, hd), vc.dtype)
-                zk = jax.lax.dynamic_update_slice(zk, kc, (0, 0, 0, 0, 0))
-                zv = jax.lax.dynamic_update_slice(zv, vc, (0, 0, 0, 0, 0))
-                ck = jax.lax.dynamic_update_slice(ck, zk, (0, slot, 0, 0, 0))
-                cv = jax.lax.dynamic_update_slice(cv, zv, (0, slot, 0, 0, 0))
-                return ck, cv
-            fn = self._insert_jits[bucket] = jax.jit(
-                insert, donate_argnums=(0, 1))
+            key = ("insert_slot", S)
+            fn = progs.get(key)
+            if fn is None:
+                def insert(ck, cv, kc, vc, slot):
+                    counters.inc("serving.retraces")
+                    zk = jnp.zeros((L, 1, S, nh, hd), kc.dtype)
+                    zv = jnp.zeros((L, 1, S, nh, hd), vc.dtype)
+                    zk = jax.lax.dynamic_update_slice(zk, kc,
+                                                      (0, 0, 0, 0, 0))
+                    zv = jax.lax.dynamic_update_slice(zv, vc,
+                                                      (0, 0, 0, 0, 0))
+                    ck = jax.lax.dynamic_update_slice(ck, zk,
+                                                      (0, slot, 0, 0, 0))
+                    cv = jax.lax.dynamic_update_slice(cv, zv,
+                                                      (0, slot, 0, 0, 0))
+                    return ck, cv
+                fn = progs[key] = jax.jit(insert, donate_argnums=(0, 1))
+            self._insert_jits[bucket] = fn
         return fn
 
     def _decode(self):
         if self._decode_jit is None:
-            def decode(w, ck, cv, tok, pos, keys_data, do_sample, temp,
-                       top_k, top_p):
-                counters.inc("serving.retraces")
-                logits, ck, cv = self.model.decode_slots(w, tok, pos, ck, cv)
-                keys = jax.random.wrap_key_data(keys_data)   # [B] typed
-                pair = jax.vmap(jax.random.split)(keys)      # [B, 2]
-                new_keys, kstep = pair[:, 0], pair[:, 1]
-                # per-row draw over [1, V] with the row's own key — exactly
-                # generate's categorical for a batch-1 request
-                sampled = jax.vmap(
-                    lambda k, lg, t, tk, tp: jax.random.categorical(
-                        k, filter_logits(lg[None], t, tk, tp), axis=-1)[0]
-                )(kstep, logits, temp, top_k, top_p)
-                greedy = jnp.argmax(logits, axis=-1)
-                nxt = jnp.where(do_sample, sampled, greedy).astype(jnp.int32)
-                return nxt, ck, cv, jax.random.key_data(new_keys)
-            self._decode_jit = jax.jit(decode, donate_argnums=(1, 2))
+            progs = _model_programs(self.model)
+            fn = progs.get("decode_slots")
+            if fn is None:
+                model = self.model
+
+                def decode(w, ck, cv, tok, pos, keys_data, do_sample, temp,
+                           top_k, top_p):
+                    counters.inc("serving.retraces")
+                    logits, ck, cv = model.decode_slots(w, tok, pos, ck, cv)
+                    keys = jax.random.wrap_key_data(keys_data)  # [B] typed
+                    pair = jax.vmap(jax.random.split)(keys)     # [B, 2]
+                    new_keys, kstep = pair[:, 0], pair[:, 1]
+                    # per-row draw over [1, V] with the row's own key —
+                    # exactly generate's categorical for a batch-1 request
+                    sampled = jax.vmap(
+                        lambda k, lg, t, tk, tp: jax.random.categorical(
+                            k, filter_logits(lg[None], t, tk, tp),
+                            axis=-1)[0]
+                    )(kstep, logits, temp, top_k, top_p)
+                    greedy = jnp.argmax(logits, axis=-1)
+                    nxt = jnp.where(do_sample, sampled,
+                                    greedy).astype(jnp.int32)
+                    return nxt, ck, cv, jax.random.key_data(new_keys)
+                fn = progs["decode_slots"] = jax.jit(
+                    decode, donate_argnums=(1, 2))
+            self._decode_jit = fn
         return self._decode_jit
 
     # -- request intake ------------------------------------------------------
@@ -624,6 +706,7 @@ class LLMEngine:
         (0.0 before the first decode)."""
         with self._cond:
             return {
+                "kv_layout": self.kv_layout,
                 "active": sum(r is not None for r in self._slots),
                 "queued": len(self._queue),
                 "free_slots": len(self._free),

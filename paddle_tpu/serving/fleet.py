@@ -200,11 +200,17 @@ class ServingFleet:
     def __init__(self, model, replicas=2, max_slots=4, max_seq_len=None,
                  queue_size=64, min_bucket=8, eos_token_id=None,
                  threaded=True, heartbeat_timeout_s=10.0, slo_margin=1.0,
-                 max_retries=1, warm_buckets=(), router=None):
+                 max_retries=1, warm_buckets=(), router=None,
+                 kv_layout="slots", block_size=16, n_blocks=None,
+                 prefill_chunk=None, prefix_cache=True):
         self.model = model
         self._engine_kw = dict(max_slots=max_slots, max_seq_len=max_seq_len,
                                queue_size=queue_size, min_bucket=min_bucket,
-                               eos_token_id=eos_token_id)
+                               eos_token_id=eos_token_id,
+                               kv_layout=kv_layout, block_size=block_size,
+                               n_blocks=n_blocks,
+                               prefill_chunk=prefill_chunk,
+                               prefix_cache=prefix_cache)
         self.router = router if router is not None else Router(slo_margin)
         self.threaded = bool(threaded)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
@@ -317,8 +323,9 @@ class ServingFleet:
             "fleet_rids": [r.tag.rid for r in stranded
                            if r.tag is not None],
         })
-        # the arena of a dead replica is garbage; release its HBM now
-        eng._ck = eng._cv = None
+        # the KV storage of a dead replica is garbage — slot arena or
+        # paged block pool alike; release its HBM now
+        eng.release_kv()
         requeue = []
         for er in stranded:
             freq = er.tag
@@ -380,7 +387,7 @@ class ServingFleet:
         freq = FleetRequest(rid, ids, kw, int(seed), deadline_s)
         est = int(ids.shape[0]) + int(max_new_tokens)
         rep = self.router.pick(self._candidates(), est_tokens=est,
-                               deadline_s=deadline_s)
+                               deadline_s=deadline_s, prompt=ids)
         try:
             self._dispatch(freq, rep)
         except EngineBackpressure as e:
@@ -401,7 +408,8 @@ class ServingFleet:
             rep = self.router.pick(
                 self._candidates(),
                 est_tokens=freq.kw["max_new_tokens"] - len(freq.tokens),
-                shed=False)    # requeues were admitted: never shed
+                shed=False,    # requeues were admitted: never shed
+                prompt=freq.prompt)
         left = None
         if freq.deadline is not None:
             left = max(0.0, freq.deadline - time.monotonic())
@@ -671,12 +679,38 @@ class ServingFleet:
             if rep.alive:
                 agg += st["decode_tps_ema"]
         counters.set_gauge("serving.fleet.decode_tps", agg)
-        return {"replicas": reps,
-                "alive": sum(r.alive for r in replicas),
-                "decode_tps": agg,
-                "latency": self.router.latency_summary(replicas),
-                "pending_retries": pending,
-                "requests": total,
-                "unfinished": sum(1 for f in self._requests
-                                  if not f.is_finished),
-                "closed": self._closed}
+        out = {"replicas": reps,
+               "alive": sum(r.alive for r in replicas),
+               "decode_tps": agg,
+               "latency": self.router.latency_summary(replicas),
+               "pending_retries": pending,
+               "requests": total,
+               "unfinished": sum(1 for f in self._requests
+                                 if not f.is_finished),
+               "closed": self._closed}
+        paged = [st for st in reps
+                 if st.get("kv_layout") == "paged" and st["alive"]]
+        if paged:
+            # fleet-wide block-pool / prefix-cache roll-up: sums of the
+            # per-replica monotonic counters, pooled utilization, and the
+            # derived hit rate the capacity dashboards plot
+            hits = sum(st["prefix_hits"] for st in paged)
+            misses = sum(st["prefix_misses"] for st in paged)
+            used = sum(st["blocks_used"] for st in paged)
+            tot = sum(st["blocks_total"] for st in paged)
+            out["kv"] = {
+                "blocks_total": tot,
+                "blocks_used": used,
+                "block_utilization": used / max(1, tot),
+                "prefix_hits": hits,
+                "prefix_misses": misses,
+                "prefix_hit_rate": hits / max(1, hits + misses),
+                "prefix_hit_tokens": sum(st["prefix_hit_tokens"]
+                                         for st in paged),
+                "cow_copies": sum(st["cow_copies"] for st in paged),
+                "blocks_evicted": sum(st["blocks_evicted"]
+                                      for st in paged),
+                "pool_exhausted": sum(st["pool_exhausted"]
+                                      for st in paged),
+            }
+        return out

@@ -1,0 +1,500 @@
+"""Paged KV-cache serving engine: block arena + prefix cache + chunked
+prefill (``LLMEngine(kv_layout="paged")``).
+
+The slot engine charges every request the worst case: one arena row of
+``S_max`` positions.  The paged engine replaces the row with a **block
+table**: KV lives in a shared donated pool ``[L, n_blocks, block_size,
+nh, hd]`` and each slot carries a fixed-shape int32 table mapping its
+logical block index to a physical pool block.  Three consequences:
+
+* **Capacity** — a request reserves only ``ceil((T + max_new - 1)/bs)``
+  blocks, so concurrent-user capacity at fixed KV HBM scales with the
+  *actual* sequence lengths, not ``S_max`` (vLLM, SOSP '23).
+  Reservation is all-or-nothing at admission, so decode can never hit
+  mid-flight exhaustion and a refused admission never tears a table.
+* **Prefix sharing** — finished sequences donate their blocks to a
+  radix tree (``serving.kvcache.PrefixCache``); a prompt that shares a
+  cached prefix adopts those blocks read-only instead of re-prefilling
+  (RadixAttention).  A shared *partial* block is adopted by
+  **copy-on-write**: one compiled copy program clones it into the
+  request's private tail block (``serving.kv.cow_copies``), so shared
+  blocks are never mutated.  Unreferenced tree blocks are reclaimed LRU
+  (``serving.kv.blocks_evicted``) when the pool runs dry.
+* **Chunked prefill** — prompts prefill in fixed-size bucketed chunks
+  (``prefill_chunk`` knob), one chunk per scheduler step, interleaved
+  with the decode launch, so a long prompt can never starve another
+  user's inter-token latency.
+
+TPU discipline is unchanged from the slot engine: block tables ride the
+compiled programs as int32 OPERANDS (never shape inputs), so steady
+state stays O(log prefill_chunk) chunk programs + ONE decode program +
+one COW copy program with zero retraces; the pool is donated through
+every launch.  Sampling replicates ``GPT.generate``'s key-split chain
+exactly (only the final chunk's sample is consumed), so paged output is
+token-identical to the slot engine and to sequential ``generate``.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..profiler import counters
+from ..profiler import flight
+from ..profiler import metrics
+from ..profiler.host_tracer import span
+from .engine import LLMEngine, _model_programs, bucket_length
+from .kvcache import BlockPool, PrefixCache, blocks_for_tokens
+
+__all__ = ["PagedLLMEngine"]
+
+
+class PagedLLMEngine(LLMEngine):
+    """``LLMEngine`` over a paged block-pool KV arena.
+
+    Extra knobs (all inert under ``kv_layout="slots"``):
+
+    * ``block_size`` — tokens per KV block (default 16).
+    * ``n_blocks`` — physical pool blocks *including* the reserved trash
+      block 0; default sizes the pool to the slot arena's HBM footprint
+      (``max_slots * ceil(S_max/bs) + 1``).
+    * ``prefill_chunk`` — max tokens prefilled per scheduler step
+      (default ``min(S_max, 128)``); chunk programs are bucketed
+      powers-of-two up to this, like the slot engine's prefill buckets.
+    * ``prefix_cache`` — enable the COW prefix tree (default True).
+    """
+
+    # -- construction hooks --------------------------------------------------
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.hists["serving.kv.block_occupancy"] = metrics.Histogram(
+            "serving.kv.block_occupancy", "frac")
+
+    def _init_kv(self, c, B, S, nh, hd, dt):
+        bs = self.block_size
+        if not 1 <= bs <= S:
+            raise ValueError(f"block_size {bs} outside [1, {S}]")
+        self.max_blocks = blocks_for_tokens(S, bs)
+        if self.n_blocks is None:
+            self.n_blocks = B * self.max_blocks + 1
+        self.n_blocks = int(self.n_blocks)
+        if self.prefill_chunk is None:
+            self.prefill_chunk = min(S, 128)
+        self.prefill_chunk = max(int(self.prefill_chunk), self.min_bucket)
+        self.pool = BlockPool(self.n_blocks, bs)
+        self.prefix = PrefixCache(self.pool) if self.prefix_caching else None
+        self._pk = jnp.zeros((c.num_layers, self.n_blocks, bs, nh, hd), dt)
+        self._pv = jnp.zeros((c.num_layers, self.n_blocks, bs, nh, hd), dt)
+        # per-slot block tables (host mirror; rides decode as an operand)
+        self._bt = np.zeros((B, self.max_blocks), np.int32)
+        self._running = np.zeros(B, np.bool_)
+        self._slot_blocks = [None] * B
+        self._prefill_state = {}      # slot -> {"req": Request, "done": n}
+        self._pchunk_jits = {}        # chunk bucket -> jitted prefill
+        self._pdecode_jit = None
+        self._pcopy_jit = None
+        # per-engine prefix-cache accounting (the fleet sums these; the
+        # same events also feed the process-global counters registry)
+        self.kv_prefix_hits = 0
+        self.kv_prefix_misses = 0
+        self.kv_prefix_hit_tokens = 0
+        self.kv_cow_copies = 0
+        self.kv_blocks_evicted = 0
+        self.kv_pool_exhausted_events = 0
+
+    def release_kv(self):
+        self._pk = self._pv = None
+
+    def prefix_peek(self, prompt):
+        if self.prefix is None:
+            return 0
+        ids = np.asarray(
+            prompt._data if hasattr(prompt, "_data") else prompt,
+            dtype=np.int32).reshape(-1)
+        with self._cond:
+            return self.prefix.peek(ids.tolist(), int(ids.shape[0]) - 1)
+
+    # -- compiled programs ---------------------------------------------------
+    # The jitted callables live in the per-model cache shared by every
+    # engine over the same model (see engine._model_programs): the
+    # closures capture the MODEL only, and jax.jit keys compiled variants
+    # by argument shape, so chunk buckets and differing pool sizes each
+    # get their own executable while identical engines reuse them.
+    def _pchunk_for(self, bucket):
+        fn = self._pchunk_jits.get(bucket)
+        if fn is None:
+            progs = _model_programs(self.model)
+            fn = progs.get("prefill_paged")
+            if fn is None:
+                model = self.model
+
+                def pchunk(w, ids, start, length, bt, pk, pv, key_data,
+                           do_sample, temp, top_k, top_p):
+                    counters.inc("serving.retraces")  # trace-time only
+                    pk, pv, logits = model.prefill_paged(
+                        w, ids, start, length, bt, pk, pv)
+                    tok, new_key = LLMEngine._first_token(
+                        logits, jax.random.wrap_key_data(key_data),
+                        do_sample, temp, top_k, top_p)
+                    return pk, pv, tok, new_key
+                fn = progs["prefill_paged"] = jax.jit(
+                    pchunk, donate_argnums=(5, 6))
+            self._pchunk_jits[bucket] = fn
+            counters.set_gauge("serving.prefill_programs",
+                               len(self._pchunk_jits))
+        return fn
+
+    def _pdecode(self):
+        if self._pdecode_jit is None:
+            progs = _model_programs(self.model)
+            fn = progs.get("decode_paged")
+            if fn is None:
+                model = self.model
+
+                def decode(w, pk, pv, bt, tok, pos, keys_data, do_sample,
+                           temp, top_k, top_p):
+                    counters.inc("serving.retraces")
+                    logits, pk, pv = model.decode_paged(
+                        w, tok, pos, bt, pk, pv)
+                    keys = jax.random.wrap_key_data(keys_data)
+                    pair = jax.vmap(jax.random.split)(keys)
+                    new_keys, kstep = pair[:, 0], pair[:, 1]
+                    from .sampling import filter_logits
+                    sampled = jax.vmap(
+                        lambda k, lg, t, tk, tp: jax.random.categorical(
+                            k, filter_logits(lg[None], t, tk, tp),
+                            axis=-1)[0]
+                    )(kstep, logits, temp, top_k, top_p)
+                    greedy = jnp.argmax(logits, axis=-1)
+                    nxt = jnp.where(do_sample, sampled,
+                                    greedy).astype(jnp.int32)
+                    return nxt, pk, pv, jax.random.key_data(new_keys)
+                fn = progs["decode_paged"] = jax.jit(
+                    decode, donate_argnums=(1, 2))
+            self._pdecode_jit = fn
+        return self._pdecode_jit
+
+    def _pcopy(self):
+        """Copy-on-write block clone: ``dst[:nvalid] = src[:nvalid]``,
+        zero beyond (one fixed-shape donated program)."""
+        if self._pcopy_jit is None:
+            progs = _model_programs(self.model)
+            fn = progs.get("copy_block")
+            if fn is None:
+                def copyb(pk, pv, src, dst, nvalid):
+                    counters.inc("serving.retraces")
+                    bs = pk.shape[2]
+                    valid = (jnp.arange(bs) < nvalid)[None, :, None, None]
+                    kb = jnp.where(valid, jax.lax.dynamic_slice_in_dim(
+                        pk, src, 1, axis=1)[:, 0], 0)
+                    vb = jnp.where(valid, jax.lax.dynamic_slice_in_dim(
+                        pv, src, 1, axis=1)[:, 0], 0)
+                    pk = jax.lax.dynamic_update_slice(
+                        pk, kb[:, None], (0, dst, 0, 0, 0))
+                    pv = jax.lax.dynamic_update_slice(
+                        pv, vb[:, None], (0, dst, 0, 0, 0))
+                    return pk, pv
+                fn = progs["copy_block"] = jax.jit(
+                    copyb, donate_argnums=(0, 1))
+            self._pcopy_jit = fn
+        return self._pcopy_jit
+
+    # -- request intake ------------------------------------------------------
+    def add_request(self, prompt, max_new_tokens=32, **kw):
+        ids = np.asarray(
+            prompt._data if hasattr(prompt, "_data") else prompt,
+            dtype=np.int32).reshape(-1)
+        need = blocks_for_tokens(
+            max(1, int(ids.shape[0]) + int(max_new_tokens) - 1),
+            self.pool.block_size)
+        if need > self.pool.capacity:
+            raise ValueError(
+                f"request needs {need} KV blocks but the pool only has "
+                f"{self.pool.capacity} (n_blocks={self.n_blocks}, "
+                f"block_size={self.pool.block_size})")
+        return super().add_request(ids, max_new_tokens=max_new_tokens, **kw)
+
+    # -- admission: all-or-nothing block reservation -------------------------
+    def _reserve(self, req, events):
+        """Match the prefix cache, then reserve every block the request
+        can ever touch (``ceil((T + max_new - 1)/bs)`` minus shared
+        prefix blocks).  Returns False — with NOTHING allocated and no
+        table mutated — when the pool (after LRU eviction) cannot cover
+        it, or when the ``kv_pool_exhausted`` fault is scheduled for
+        this request id."""
+        from ..resilience import faultinject as _fi
+        T = int(req.prompt.shape[0])
+        bs = self.pool.block_size
+        total = blocks_for_tokens(max(1, T + req.max_new_tokens - 1), bs)
+        with self._cond:
+            injected = _fi.take("kv_pool_exhausted", req.rid)
+            shared, cached, pnode, p = [], 0, None, 0
+            if self.prefix is not None and not injected:
+                shared, cached, pnode, p = self.prefix.match(
+                    req.prompt.tolist(), T - 1)
+            fresh_needed = total - len(shared)
+            shortfall = fresh_needed - self.pool.free_blocks
+            if shortfall > 0 and self.prefix is not None:
+                self.kv_blocks_evicted += self.prefix.evict(shortfall)
+                shortfall = fresh_needed - self.pool.free_blocks
+            if injected or shortfall > 0:
+                for b in shared:
+                    self.pool.release(b)
+                if pnode is not None:
+                    self.pool.release(pnode.block)
+                self.kv_pool_exhausted_events += 1
+                counters.inc("serving.kv.pool_exhausted")
+                flight.record("serving.kv.pool_exhausted", rid=req.rid,
+                              needed=fresh_needed,
+                              free=self.pool.free_blocks,
+                              injected=bool(injected))
+                return False
+            fresh = self.pool.alloc_n(fresh_needed)
+            table = shared + fresh
+            slot = self._free.pop()
+            if pnode is not None:
+                # copy-on-write: clone the shared partial block into the
+                # request's first private tail block before extending it
+                cp = self._pcopy()
+                cargs = (self._pk, self._pv, np.int32(pnode.block),
+                         np.int32(table[len(shared)]), np.int32(p))
+                self._maybe_capture("serving.kv.copy_block", cp, *cargs)
+                self._pk, self._pv = cp(*cargs)
+                self.pool.release(pnode.block)   # drop the match retain
+                cached += p
+                self.kv_cow_copies += 1
+                counters.inc("serving.kv.cow_copies")
+            if cached > 0:
+                self.kv_prefix_hits += 1
+                self.kv_prefix_hit_tokens += cached
+                counters.inc("serving.kv.prefix_hits")
+                counters.inc("serving.kv.prefix_hit_tokens", cached)
+            else:
+                self.kv_prefix_misses += 1
+                counters.inc("serving.kv.prefix_misses")
+            self._slot_blocks[slot] = table
+            self._bt[slot] = 0
+            self._bt[slot, :len(table)] = table
+            self._running[slot] = False
+            req.state = "prefilling"
+            req.slot = slot
+            self._slots[slot] = req
+            self._prefill_state[slot] = {"req": req, "done": cached}
+        flight.record("serving.kv.admit", rid=req.rid, blocks=len(table),
+                      shared=len(shared), cached_tokens=cached)
+        events.append({"type": "admitted", "request": req})
+        return True
+
+    def _admit(self, events):
+        now = time.monotonic()
+        while self._free:
+            with self._cond:
+                if not self._queue:
+                    return
+                req = self._queue.popleft()
+                self._cond.notify()
+            if req._cancel:
+                self._finish(req, "cancelled", events)
+                continue
+            if req.deadline is not None and now > req.deadline:
+                counters.inc("serving.deadline_expired")
+                self._finish(req, "deadline", events)
+                continue
+            if not self._reserve(req, events):
+                # pool exhausted (real or injected): park the request back
+                # at the queue head and stop admitting this step — blocks
+                # free as running requests finish, and callers see the
+                # backlog as EngineBackpressure with a drain-rate hint
+                with self._cond:
+                    self._queue.appendleft(req)
+                return
+            self._observe("serving.queue_wait_ns",
+                          time.monotonic_ns() - req.arrival_ns,
+                          sum_counter=True)
+
+    # -- chunked prefill, interleaved with decode ----------------------------
+    def _run_chunk(self, slot, st, events):
+        req = st["req"]
+        T = int(req.prompt.shape[0])
+        start = st["done"]
+        remaining = T - start
+        C = bucket_length(min(remaining, self.prefill_chunk),
+                          self.min_bucket, self.prefill_chunk)
+        take_n = min(remaining, C)
+        last = start + take_n == T
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :take_n] = req.prompt[start:start + take_n]
+        # every chunk is fed the request's ORIGINAL seed key; only the
+        # final chunk's sample/key are consumed, so the key-split chain
+        # is exactly generate's one-split-after-prefill
+        key_data = np.asarray(
+            jax.random.key_data(jax.random.key(req.seed)))
+        self._observe("serving.prefill_occupancy", take_n / C)
+        with span("serving.prefill"):
+            pf = self._pchunk_for(C)
+            pargs = (self._w, jnp.asarray(ids), np.int32(start),
+                     np.int32(take_n), jnp.asarray(self._bt[slot]),
+                     self._pk, self._pv, key_data,
+                     np.bool_(req.do_sample), np.float32(req.temperature),
+                     np.int32(req.top_k), np.float32(req.top_p))
+            self._maybe_capture(f"serving.prefill_paged[c{C}]", pf, *pargs)
+            self._pk, self._pv, tok, new_key = pf(*pargs)
+        counters.inc("serving.kv.prefill_chunks")
+        st["done"] = start + take_n
+        if last:
+            del self._prefill_state[slot]
+            counters.inc("serving.prefill_batches")
+            req.state = "running"
+            self._running[slot] = True
+            self._tok[slot] = int(tok)
+            self._pos[slot] = T
+            self._keys[slot] = np.asarray(new_key)
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._topp[slot] = req.top_p
+            self._dosample[slot] = req.do_sample
+            self._emit(req, int(tok), events)
+
+    def _prefill_chunks(self, events):
+        """One chunk per prefilling slot per step (round-robin in slot
+        order): a long prompt advances ``prefill_chunk`` tokens per
+        scheduler iteration while every running request still gets its
+        decode token — chunked prefill can never starve ITL."""
+        from ..resilience import faultinject as _fi
+        for slot in sorted(self._prefill_state):
+            st = self._prefill_state.get(slot)
+            if st is None or st["req"].is_finished:
+                continue
+            req = st["req"]
+            try:
+                _fi.maybe_fault("serving_prefill", req.rid)
+                self._run_chunk(slot, st, events)
+            except Exception as e:
+                # same containment contract as the slot engine's _admit:
+                # a poisoned prefill finishes THIS request with
+                # finish_reason="error" and frees its slot + blocks
+                req.error = e
+                counters.inc("serving.request_errors")
+                self._finish(req, "error", events)
+
+    # -- decode over block tables --------------------------------------------
+    def _decode_step(self, events):
+        active = [(s, r) for s, r in enumerate(self._slots)
+                  if r is not None and r.state == "running"]
+        if not active:
+            return
+        self._observe("serving.decode_occupancy",
+                      len(active) / self.max_slots)
+        # non-running rows (idle or mid-prefill) are tabled to the trash
+        # block at position 0: the ONE decode program runs every launch
+        # with fixed shapes, whatever subset of rows is live
+        bt_eff = np.where(self._running[:, None], self._bt,
+                          0).astype(np.int32)
+        pos_eff = np.where(self._running, self._pos, 0).astype(np.int32)
+        t0 = time.perf_counter()
+        with span("serving.decode"):
+            dec = self._pdecode()
+            dargs = (self._w, self._pk, self._pv, jnp.asarray(bt_eff),
+                     jnp.asarray(self._tok), jnp.asarray(pos_eff),
+                     jnp.asarray(self._keys), jnp.asarray(self._dosample),
+                     jnp.asarray(self._temp), jnp.asarray(self._topk),
+                     jnp.asarray(self._topp))
+            self._maybe_capture("serving.decode_paged", dec, *dargs)
+            nxt, self._pk, self._pv, new_keys = dec(*dargs)
+            nxt = np.asarray(nxt)
+        self._keys = np.array(new_keys)  # mutable host copy
+        inst = len(active) / max(time.perf_counter() - t0, 1e-9)
+        with self._cond:
+            self._tps_ema = (inst if self._tps_ema <= 0 else
+                             self._ema_alpha * inst
+                             + (1 - self._ema_alpha) * self._tps_ema)
+        counters.inc("serving.decode_steps")
+        counters.inc("serving.decode_tokens", len(active))
+        for s, req in active:
+            self._tok[s] = nxt[s]
+            self._pos[s] += 1
+            self._emit(req, nxt[s], events)
+
+    # -- eviction / teardown -------------------------------------------------
+    def _release_slot_kv(self, slot, req, reason):
+        """Free a finished request's table: donate the sequence's blocks
+        to the prefix tree (when prefill completed cleanly), then drop
+        the request's references.  Caller holds ``_cond``."""
+        table = self._slot_blocks[slot]
+        self._slot_blocks[slot] = None
+        st = self._prefill_state.pop(slot, None)
+        self._running[slot] = False
+        self._bt[slot] = 0
+        if table is None:
+            return
+        if self.prefix is not None and st is None and reason != "error" \
+                and req.tokens:
+            # K/V is live through position T + len(tokens) - 2 (the last
+            # emitted token was sampled but never written back)
+            n_avail = int(req.prompt.shape[0]) + len(req.tokens) - 1
+            seq = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])[:n_avail]
+            self.prefix.insert(seq.tolist(), table)
+        for b in table:
+            self.pool.release(b)
+
+    def _finish(self, req, reason, events):
+        with self._cond:
+            slot = req.slot
+            done = super()._finish(req, reason, events)
+            if done and slot is not None:
+                self._release_slot_kv(slot, req, reason)
+        return done
+
+    # -- scheduling ----------------------------------------------------------
+    def step(self):
+        """One scheduler iteration: sweep cancels/deadlines, admit from
+        the queue (prefix match + block reservation only — no model
+        launches), advance every mid-prefill request by ONE chunk, run
+        ONE decode launch for all running slots, re-admit into anything
+        freed this step."""
+        with span("serving.step"):
+            events = []
+            self._sweep(events)
+            self._admit(events)
+            self._prefill_chunks(events)
+            self._decode_step(events)
+            self._admit(events)
+        counters.set_gauge(
+            "serving.slot_occupancy",
+            sum(r is not None for r in self._slots) / self.max_slots)
+        used = self.pool.used_blocks
+        counters.set_gauge("serving.kv.blocks_used", used)
+        self._observe("serving.kv.block_occupancy",
+                      used / max(1, self.pool.capacity))
+        return events
+
+    def stats(self):
+        """Slot-engine snapshot plus the block-pool / prefix-cache
+        fields the Router's fleet aggregation merges (one lock
+        acquisition; the RLock makes the nested base call atomic)."""
+        with self._cond:
+            st = super().stats()
+            st.update({
+                "kv_layout": "paged",
+                "prefill_programs": len(self._pchunk_jits),
+                "block_size": self.pool.block_size,
+                "blocks_total": self.pool.capacity,
+                "blocks_free": self.pool.free_blocks,
+                "blocks_used": self.pool.used_blocks,
+                "block_utilization": (self.pool.used_blocks
+                                      / max(1, self.pool.capacity)),
+                "prefix_hits": self.kv_prefix_hits,
+                "prefix_misses": self.kv_prefix_misses,
+                "prefix_hit_tokens": self.kv_prefix_hit_tokens,
+                "cow_copies": self.kv_cow_copies,
+                "blocks_evicted": self.kv_blocks_evicted,
+                "pool_exhausted": self.kv_pool_exhausted_events,
+                "prefix_nodes": (0 if self.prefix is None
+                                 else self.prefix.nodes),
+                "prefilling": len(self._prefill_state),
+            })
+        return st

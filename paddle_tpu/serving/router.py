@@ -88,14 +88,24 @@ class Router:
         return {n: h.summary()
                 for n, h in Router.aggregate_histograms(replicas).items()}
 
-    def pick(self, replicas, est_tokens=0, deadline_s=None, shed=True):
+    def pick(self, replicas, est_tokens=0, deadline_s=None, shed=True,
+             prompt=None):
         """Choose a replica for a request costing ``est_tokens`` decode
         tokens.  ``replicas`` is the candidate list (alive + warmed).
         Raises :class:`RetryAfter` when every queue is full or — with
         ``shed=True`` and a ``deadline_s`` budget — when the SLO estimate
         says the request cannot finish in time.  Requeued (already
         admitted) requests route with ``shed=False``: they must reach a
-        terminal state, never be shed."""
+        terminal state, never be shed.
+
+        With ``prompt`` (the request's token ids) the score becomes
+        prefix-hit-aware: each candidate's backlog is discounted by the
+        prompt tokens its paged prefix cache could serve without
+        prefilling (``LLMEngine.prefix_peek``; 0 under the slot layout),
+        so shared-prompt traffic gravitates to the replica that already
+        holds the prefix instead of re-prefilling it elsewhere.  A pick
+        won on a nonzero discount counts ``serving.fleet.prefix_routed``.
+        """
         cands, hints, depths = [], [], []
         for rep in replicas:
             st = rep.engine.stats()     # atomic per-replica snapshot
@@ -107,7 +117,10 @@ class Router:
                              / st["decode_tps_ema"])
             if st["queued"] >= rep.engine.queue_size:
                 continue                # bounded queue full: not a candidate
-            cands.append((st["outstanding_tokens"], rep.idx, rep, st))
+            peek = (rep.engine.prefix_peek(prompt)
+                    if prompt is not None else 0)
+            cands.append((st["outstanding_tokens"] - peek, rep.idx,
+                          rep, st, peek))
         if not cands:
             raise RetryAfter(
                 "every replica queue is full",
@@ -115,7 +128,10 @@ class Router:
                 retry_after_hint=min(hints) if hints else None,
                 reason="backpressure")
         cands.sort(key=lambda t: (t[0], t[1]))
-        backlog, _, rep, st = cands[0]
+        _, _, rep, st, peek = cands[0]
+        if peek > 0:
+            counters.inc("serving.fleet.prefix_routed")
+        backlog = st["outstanding_tokens"]   # SLO math on the REAL backlog
         if shed and deadline_s is not None and st["decode_tps_ema"] > 0:
             est_done_s = (backlog + est_tokens) / st["decode_tps_ema"]
             if est_done_s * self.slo_margin > float(deadline_s):

@@ -263,6 +263,80 @@ def run():
                        for k, want in sinvariants.items()
                        if ssteady.get(k, 0) != want})
 
+    # ---- paged-KV gate: fixed block tables never retrace ----------------
+    # Same workload discipline as the serving gate, against the paged
+    # engine: block tables are int32 OPERANDS, so the warm chunk buckets
+    # + ONE decode program + ONE COW copy program must cover the measure
+    # window with zero retraces/hydrates/host binds.
+    peng = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4,
+                     kv_layout="paged", block_size=4, prefill_chunk=8)
+
+    def pserve(eng_, lens):
+        hs = [eng_.add_request(rng.randint(0, 64, size=n).tolist(),
+                               max_new_tokens=3) for n in lens]
+        while not all(h.is_finished for h in hs):
+            eng_.step()
+        return hs
+
+    ph0 = pserve(peng, SERVE_LENS_WARM)[0]
+    # warm the copy-on-write program too: extend a sequence the warm
+    # requests left in the prefix tree past its cached partial block
+    cow_warm = (list(ph0.prompt) + ph0.tokens)[:5] + [int(ph0.prompt[0])]
+    pserve_cow = peng.add_request(cow_warm, max_new_tokens=3)
+    while not pserve_cow.is_finished:
+        peng.step()
+
+    pbefore = counters.snapshot()
+    phs = pserve(peng, SERVE_LENS_MEASURE)
+    psteady = counters.delta(pbefore)
+    pinvariants = {
+        "serving.retraces": 0,
+        "jit.traces": 0,
+        "jit.hydrates": 0,
+        "jit.syncs": 0,
+        "serving.requests": len(SERVE_LENS_MEASURE),
+        "serving.evictions": len(SERVE_LENS_MEASURE),
+    }
+    pinvariants.update({"jit.host." + k: 0 for k in pjit._HOST_SYNC_KEYS})
+    violations.update({f"paged:{k}": (psteady.get(k, 0), want)
+                       for k, want in pinvariants.items()
+                       if psteady.get(k, 0) != want})
+    for h in phs:   # paged output must equal sequential generate
+        pref = np.asarray(smodel.generate(
+            paddle.to_tensor(np.asarray([list(h.prompt)])),
+            max_new_tokens=3).numpy())[0][len(h.prompt):].tolist()
+        if h.tokens != pref:
+            violations[f"paged:identity@{h.rid}"] = (h.tokens, pref)
+
+    # shared-prefix leg: against a no-cache twin serving the SAME
+    # workload, the prefix cache must score hits and launch strictly
+    # fewer prefill chunks
+    psys = rng.randint(0, 64, size=12).tolist()
+    ptails = [rng.randint(0, 64, size=4).tolist() for _ in range(3)]
+    pnc = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4,
+                    kv_layout="paged", block_size=4, prefill_chunk=8,
+                    prefix_cache=False)
+    ncbefore = counters.snapshot()
+    for t in ptails:
+        h = pnc.add_request(psys + t, max_new_tokens=3)
+        while not h.is_finished:
+            pnc.step()
+    nc_chunks = counters.delta(ncbefore).get("serving.kv.prefill_chunks", 0)
+    pc = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4,
+                   kv_layout="paged", block_size=4, prefill_chunk=8)
+    pcbefore = counters.snapshot()
+    for t in ptails:    # sequential, so each finish feeds the tree
+        h = pc.add_request(psys + t, max_new_tokens=3)
+        while not h.is_finished:
+            pc.step()
+    pcdelta = counters.delta(pcbefore)
+    pc_chunks = pcdelta.get("serving.kv.prefill_chunks", 0)
+    pc_hits = pcdelta.get("serving.kv.prefix_hits", 0)
+    if pc_hits < 2:
+        violations["paged-prefix:hits"] = (pc_hits, ">=2")
+    if not pc_chunks < nc_chunks:
+        violations["paged-prefix:chunks"] = (pc_chunks, f"<{nc_chunks}")
+
     # ---- elastic-fleet gate: zero lost under churn, warm replicas -------
     from paddle_tpu.resilience import faultinject
     from paddle_tpu.serving import ServingFleet
@@ -509,6 +583,11 @@ def run():
               "mesh_fused_delta": fmsteady,
               "serving_steady_delta": ssteady,
               "serving_prefill_programs": eng.stats()["prefill_programs"],
+              "paged_steady_delta": psteady,
+              "paged_prefill_programs": peng.stats()["prefill_programs"],
+              "paged_prefix": {"hits": pc_hits,
+                               "chunks_cached": pc_chunks,
+                               "chunks_nocache": nc_chunks},
               "fleet_steady_delta": flsteady,
               "fleet_churn_delta": {k: v for k, v in chsteady.items()
                                     if k.startswith("serving.fleet.")},

@@ -1,0 +1,428 @@
+"""Paged KV-cache subsystem (paddle_tpu.serving.kvcache / .paged).
+
+The load-bearing contracts: (1) the paged engine is TOKEN-IDENTICAL to
+the legacy slot arena and to sequential GPT.generate — block tables,
+prefix sharing, copy-on-write, and chunked prefill must be invisible in
+the tokens; (2) block accounting never tears — all-or-nothing
+reservation, refcounted sharing, LRU eviction only of unreferenced
+blocks; (3) exhaustion (real or injected) defers admission and surfaces
+as backpressure, never a crash.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import counters
+from paddle_tpu.resilience import faultinject
+from paddle_tpu.serving.kvcache import (TRASH_BLOCK, BlockPool,
+                                        BlockPoolExhausted, PrefixCache,
+                                        blocks_for_tokens)
+
+_MODEL = None
+
+
+def _model():
+    global _MODEL
+    if _MODEL is None:
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_seq_len=32,
+                        use_flash_attention=False)
+        paddle.seed(31)
+        _MODEL = GPTForCausalLM(cfg)
+        _MODEL.eval()
+    return _MODEL
+
+
+def _paged(m, **kw):
+    from paddle_tpu.serving import LLMEngine
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("min_bucket", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prefill_chunk", 8)
+    return LLMEngine(m, kv_layout="paged", **kw)
+
+
+def _ref_generate(m, prompt, max_new, **kw):
+    out = np.asarray(m.generate(paddle.to_tensor(np.asarray([prompt])),
+                                max_new_tokens=max_new, **kw).numpy())[0]
+    return out[len(prompt):].tolist()
+
+
+def _run(eng, handles, limit=300):
+    n = 0
+    while not all(h.is_finished for h in handles):
+        eng.step()
+        n += 1
+        assert n < limit, "engine did not converge"
+    return n
+
+
+class TestBlockPool:
+    def test_alloc_free_refcount(self):
+        pool = BlockPool(5, 4)
+        assert pool.capacity == 4 and pool.free_blocks == 4
+        a = pool.alloc()
+        assert a != TRASH_BLOCK and pool.ref(a) == 1
+        pool.retain(a)
+        assert pool.ref(a) == 2
+        assert pool.release(a) is False       # still held
+        assert pool.release(a) is True        # freed
+        assert pool.free_blocks == 4
+
+    def test_alloc_n_all_or_nothing(self):
+        pool = BlockPool(5, 4)
+        got = pool.alloc_n(3)
+        assert len(got) == 3 and pool.free_blocks == 1
+        with pytest.raises(BlockPoolExhausted) as ei:
+            pool.alloc_n(2)
+        assert ei.value.needed == 2 and ei.value.free == 1
+        assert pool.free_blocks == 1          # nothing torn off
+
+    def test_trash_block_reserved(self):
+        pool = BlockPool(3, 4)
+        blocks = pool.alloc_n(2)
+        assert TRASH_BLOCK not in blocks
+        with pytest.raises(BlockPoolExhausted):
+            pool.alloc()
+        with pytest.raises(ValueError):
+            pool.retain(TRASH_BLOCK)
+
+    def test_release_free_block_raises(self):
+        pool = BlockPool(3, 4)
+        with pytest.raises(ValueError):
+            pool.release(1)
+
+    def test_blocks_for_tokens(self):
+        assert blocks_for_tokens(1, 4) == 1
+        assert blocks_for_tokens(4, 4) == 1
+        assert blocks_for_tokens(5, 4) == 2
+        assert blocks_for_tokens(16, 4) == 4
+
+
+class TestPrefixCache:
+    def test_match_full_and_partial(self):
+        pool = BlockPool(9, 4)
+        cache = PrefixCache(pool)
+        seq = list(range(10))                       # 2 full blocks + 2 rest
+        blocks = pool.alloc_n(3)
+        assert cache.insert(seq, blocks) == 3
+        for b in blocks:
+            pool.release(b)                         # donor refs dropped
+        assert all(pool.ref(b) == 1 for b in blocks)
+
+        # full-block hit: first 8 tokens shared, partial [8,9] usable
+        got, cached, pn, p = cache.match(seq + [42], limit=10)
+        assert got == blocks[:2] and cached == 8
+        assert pn is not None and pn.block == blocks[2] and p == 2
+        assert pool.ref(blocks[0]) == 2             # retained for caller
+        for b in got:
+            pool.release(b)
+
+        # limit clips the partial
+        got, cached, pn, p = cache.match(seq, limit=9)
+        assert cached == 8 and p == 1
+        for b in got:
+            pool.release(b)
+
+        # divergent second block: only the first is shared
+        div = seq[:4] + [63, 62, 61, 60]
+        got, cached, pn, p = cache.match(div, limit=8)
+        assert got == blocks[:1] and cached == 4 and pn is None
+        for b in got:
+            pool.release(b)
+
+    def test_peek_is_read_only(self):
+        pool = BlockPool(9, 4)
+        cache = PrefixCache(pool)
+        seq = list(range(10))
+        blocks = pool.alloc_n(3)
+        cache.insert(seq, blocks)
+        for b in blocks:
+            pool.release(b)
+        assert cache.peek(seq, limit=10) == 10
+        assert cache.peek(seq, limit=9) == 9
+        assert cache.peek([59] * 10, limit=10) == 0
+        assert all(pool.ref(b) == 1 for b in blocks)   # no refs taken
+
+    def test_evict_lru_unreferenced_only(self):
+        pool = BlockPool(9, 4)
+        cache = PrefixCache(pool)
+        s1, s2 = [1] * 4, [2] * 4
+        b1 = pool.alloc_n(1)
+        cache.insert(s1, b1)
+        pool.release(b1[0])
+        b2 = pool.alloc_n(1)
+        cache.insert(s2, b2)
+        pool.release(b2[0])
+        # touch s1 so s2 is LRU
+        got, *_ = cache.match(s1 + [0], limit=5)
+        assert cache.evict(1) == 1                  # evicts s2, not held s1
+        assert pool.ref(b2[0]) == 0
+        assert cache.peek(s2, limit=4) == 0
+        assert cache.peek(s1 + [0], limit=5) == 4   # s1 survives (referenced)
+        for b in got:
+            pool.release(b)
+
+    def test_evict_parent_after_child(self):
+        pool = BlockPool(9, 4)
+        cache = PrefixCache(pool)
+        seq = list(range(8))                        # chain of 2 full blocks
+        blocks = pool.alloc_n(2)
+        cache.insert(seq, blocks)
+        for b in blocks:
+            pool.release(b)
+        assert cache.evict(2) == 2                  # leaf first, then parent
+        assert cache.nodes == 0
+        assert pool.free_blocks == pool.capacity
+
+    def test_clear_releases_everything(self):
+        pool = BlockPool(9, 4)
+        cache = PrefixCache(pool)
+        blocks = pool.alloc_n(3)
+        cache.insert(list(range(10)), blocks)
+        for b in blocks:
+            pool.release(b)
+        cache.clear()
+        assert pool.free_blocks == pool.capacity and cache.nodes == 0
+
+
+class TestPagedIdentity:
+    def test_greedy_vs_generate_and_slot_engine(self):
+        m = _model()
+        from paddle_tpu.serving import LLMEngine
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 64, size=n).tolist()
+                   for n in (5, 3, 9, 6, 11)]
+        refs = [_ref_generate(m, p, 6) for p in prompts]
+        slot = LLMEngine(m, max_slots=3, max_seq_len=32, min_bucket=4)
+        hs = [slot.add_request(p, max_new_tokens=6, seed=i)
+              for i, p in enumerate(prompts)]
+        _run(slot, hs)
+        paged = _paged(m)
+        hp = [paged.add_request(p, max_new_tokens=6, seed=i)
+              for i, p in enumerate(prompts)]
+        _run(paged, hp)
+        for h, hq, r in zip(hs, hp, refs):
+            assert h.tokens == r
+            assert hq.tokens == r
+
+    def test_sampled_identity(self):
+        m = _model()
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 64, size=n).tolist() for n in (7, 4, 10)]
+        kw = dict(do_sample=True, temperature=0.8, top_k=8, top_p=0.9)
+        refs = [_ref_generate(m, p, 6, seed=100 + i, **kw)
+                for i, p in enumerate(prompts)]
+        eng = _paged(m)
+        hs = [eng.add_request(p, max_new_tokens=6, seed=100 + i, **kw)
+              for i, p in enumerate(prompts)]
+        _run(eng, hs)
+        for h, r in zip(hs, refs):
+            assert h.tokens == r
+
+    def test_chunked_prefill_identity(self):
+        m = _model()
+        rng = np.random.default_rng(4)
+        long_p = rng.integers(0, 64, size=26).tolist()
+        eng = _paged(m, prefill_chunk=8)            # 26 tokens -> 4 chunks
+        before = counters.snapshot().get("serving.kv.prefill_chunks", 0)
+        h = eng.add_request(long_p, max_new_tokens=5, seed=9)
+        _run(eng, [h])
+        chunks = counters.snapshot().get("serving.kv.prefill_chunks",
+                                         0) - before
+        assert chunks == 4
+        assert h.tokens == _ref_generate(m, long_p, 5)
+
+    def test_shared_prefix_hit_identity(self):
+        m = _model()
+        rng = np.random.default_rng(5)
+        sys_p = rng.integers(0, 64, size=12).tolist()
+        eng = _paged(m)
+        tails = [rng.integers(0, 64, size=4).tolist() for _ in range(3)]
+        first = eng.add_request(sys_p + tails[0], max_new_tokens=4, seed=0)
+        _run(eng, [first])
+        assert first.tokens == _ref_generate(m, sys_p + tails[0], 4)
+        st0 = eng.stats()
+        hs = [eng.add_request(sys_p + t, max_new_tokens=4, seed=1 + i)
+              for i, t in enumerate(tails[1:])]
+        _run(eng, hs)
+        st = eng.stats()
+        assert st["prefix_hits"] - st0["prefix_hits"] == 2
+        assert st["prefix_hit_tokens"] > st0["prefix_hit_tokens"]
+        for h, t in zip(hs, tails[1:]):
+            assert h.tokens == _ref_generate(m, sys_p + t, 4)
+
+    def test_cow_partial_block_identity(self):
+        m = _model()
+        rng = np.random.default_rng(6)
+        p1 = rng.integers(0, 64, size=10).tolist()
+        eng = _paged(m)
+        h1 = eng.add_request(p1, max_new_tokens=6, seed=2)
+        _run(eng, [h1])
+        # the finished sequence cached 15 KV positions: 3 full blocks + a
+        # 3-token partial; extending past it forces a copy-on-write
+        seq1 = p1 + h1.tokens
+        p2 = seq1[:15] + rng.integers(0, 64, size=4).tolist()
+        h2 = eng.add_request(p2, max_new_tokens=5, seed=3)
+        _run(eng, [h2])
+        st = eng.stats()
+        assert st["cow_copies"] >= 1
+        assert h2.tokens == _ref_generate(m, p2, 5)
+
+
+class TestChunkedPrefillInterleaving:
+    def test_decode_not_starved_by_long_prefill(self):
+        m = _model()
+        rng = np.random.default_rng(7)
+        eng = _paged(m, prefill_chunk=8, prefix_cache=False)
+        short = rng.integers(0, 64, size=4).tolist()
+        long_p = rng.integers(0, 64, size=24).tolist()
+        h_short = eng.add_request(short, max_new_tokens=10, seed=1)
+        eng.step()                                   # short is now decoding
+        h_long = eng.add_request(long_p, max_new_tokens=3, seed=2)
+        # while the long prompt prefills chunk by chunk, the short request
+        # must receive one token per step — chunked prefill never starves
+        # inter-token latency
+        while h_long.state != "running" and not h_long.is_finished:
+            before = len(h_short.tokens)
+            eng.step()
+            if not h_short.is_finished:
+                assert len(h_short.tokens) == before + 1
+        _run(eng, [h_short, h_long])
+        assert h_short.tokens == _ref_generate(m, short, 10)
+        assert h_long.tokens == _ref_generate(m, long_p, 3)
+
+
+class TestDeadlineAndRelease:
+    def test_deadline_expiry_mid_chunked_prefill(self):
+        m = _model()
+        rng = np.random.default_rng(8)
+        eng = _paged(m, prefill_chunk=8)
+        long_p = rng.integers(0, 64, size=24).tolist()
+        h = eng.add_request(long_p, max_new_tokens=4, seed=1,
+                            deadline_s=0.0)
+        eng.step()                                   # sweep reaps it
+        assert h.is_finished and h.finish_reason == "deadline"
+        st = eng.stats()
+        assert st["blocks_used"] == 0                # every block released
+        assert st["blocks_free"] == st["blocks_total"]
+
+    def test_cancel_mid_prefill_releases_blocks(self):
+        m = _model()
+        rng = np.random.default_rng(9)
+        eng = _paged(m, prefill_chunk=8, prefix_cache=False)
+        h = eng.add_request(rng.integers(0, 64, size=24).tolist(),
+                            max_new_tokens=4, seed=1)
+        eng.step()                                   # admitted, 1 chunk in
+        assert h.state == "prefilling"
+        h.cancel()
+        eng.step()
+        assert h.finish_reason == "cancelled"
+        assert eng.stats()["blocks_used"] == 0
+
+
+class TestExhaustionBackpressure:
+    def test_impossible_request_rejected(self):
+        m = _model()
+        eng = _paged(m, n_blocks=3)                  # 2 usable blocks
+        with pytest.raises(ValueError):
+            eng.add_request(list(range(12)), max_new_tokens=4)
+
+    def test_real_exhaustion_defers_and_recovers(self):
+        m = _model()
+        # pool fits ~1 request at a time: 6 usable blocks of 4 tokens
+        eng = _paged(m, n_blocks=7, max_slots=2, prefix_cache=False)
+        p = list(range(10))
+        h1 = eng.add_request(p, max_new_tokens=6, seed=0)      # 4 blocks
+        h2 = eng.add_request(p[::-1], max_new_tokens=6, seed=1)
+        _run(eng, [h1, h2])
+        st = eng.stats()
+        assert st["pool_exhausted"] >= 1             # h2 had to wait
+        assert h1.tokens == _ref_generate(m, p, 6)
+        assert h2.tokens == _ref_generate(m, p[::-1], 6)
+
+    def test_injected_exhaustion_is_deterministic(self):
+        m = _model()
+        eng = _paged(m)
+        h0 = eng.add_request([1, 2, 3], max_new_tokens=3, seed=0)
+        rid = h0.rid + 1
+        with faultinject.fault_schedule(f"kv_pool_exhausted@{rid}"):
+            h1 = eng.add_request([4, 5, 6], max_new_tokens=3, seed=1)
+            _run(eng, [h0, h1])
+            assert ("kv_pool_exhausted", rid) in faultinject.fired
+        assert h1.finish_reason == "length"          # deferred, not dropped
+        assert h1.tokens == _ref_generate(m, [4, 5, 6], 3)
+        assert eng.stats()["pool_exhausted"] == 1
+
+    def test_backpressure_surfaces_when_queue_fills(self):
+        from paddle_tpu.serving import EngineBackpressure
+        m = _model()
+        eng = _paged(m, max_slots=1, queue_size=1, n_blocks=9,
+                     prefix_cache=False)
+        h1 = eng.add_request(list(range(10)), max_new_tokens=6, seed=0)
+        eng.step()                                   # h1 occupies the pool
+        h2 = eng.add_request(list(range(8)), max_new_tokens=6, seed=1,
+                             block=False)            # queued
+        with pytest.raises(EngineBackpressure):
+            eng.add_request(list(range(6)), max_new_tokens=4, seed=2,
+                            block=False)             # queue full
+        _run(eng, [h1, h2])
+        assert h1.finish_reason == "length"
+        assert h2.finish_reason == "length"
+
+
+class TestRouterPrefixAware:
+    def test_pick_prefers_warm_prefix(self):
+        m = _model()
+        from paddle_tpu.serving import Replica, Router
+        rng = np.random.default_rng(10)
+        sys_p = rng.integers(0, 64, size=12).tolist()
+        warm = _paged(m)
+        cold = _paged(m)
+        h = warm.add_request(sys_p + [1, 2], max_new_tokens=4, seed=0)
+        _run(warm, [h])
+        reps = [Replica(0, cold), Replica(1, warm)]
+        before = counters.snapshot().get("serving.fleet.prefix_routed", 0)
+        picked = Router().pick(reps, est_tokens=16, prompt=sys_p + [3, 4])
+        assert picked.engine is warm                 # despite higher idx
+        got = counters.snapshot().get("serving.fleet.prefix_routed", 0)
+        assert got == before + 1
+        # without a prompt the tie breaks to the lowest index
+        assert Router().pick(reps, est_tokens=16).engine is cold
+
+
+class TestFleetPagedChaos:
+    def test_fleet_kv_stats_and_injected_exhaustion(self):
+        m = _model()
+        from paddle_tpu.serving import ServingFleet
+        rng = np.random.default_rng(11)
+        sys_p = rng.integers(0, 64, size=8).tolist()
+        with ServingFleet(m, replicas=2, max_slots=2, max_seq_len=32,
+                          min_bucket=4, threaded=False, kv_layout="paged",
+                          block_size=4, prefill_chunk=8) as fleet:
+            reqs = [fleet.submit(sys_p + rng.integers(0, 64, size=3).tolist(),
+                                 max_new_tokens=4, seed=i)
+                    for i in range(4)]
+            # chaos leg: exhaust the pool at a specific engine-level
+            # admission — the request must still finish
+            victim = fleet.submit(sys_p + [7, 8, 9], max_new_tokens=4,
+                                  seed=99)
+            erid = victim._er.rid
+            with faultinject.fault_schedule(f"kv_pool_exhausted@{erid}"):
+                n = 0
+                while any(not r.is_finished for r in reqs + [victim]):
+                    fleet.pump()
+                    n += 1
+                    assert n < 500
+                assert ("kv_pool_exhausted", erid) in faultinject.fired
+            st = fleet.stats()
+            assert st["kv"]["prefix_hits"] > 0
+            assert st["kv"]["pool_exhausted"] >= 1
+            assert st["kv"]["blocks_total"] > 0
+            for r in reqs + [victim]:
+                assert r.finish_reason in ("length", "eos")
+                ref = _ref_generate(m, list(r.prompt), 4)
+                assert r.tokens == ref
