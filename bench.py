@@ -50,10 +50,17 @@ Legs (perf round 5):
   number is informational there).
 Every training leg embeds a compact "metrics" block (loss / grad-norm /
 tok/s / step-time / MFU stats from the zero-sync in-graph MetricsLogger
-accumulators); the serve and fleet legs embed TTFT / inter-token /
-queue-wait percentiles; the ckpt leg embeds save-latency percentiles;
-the mesh legs embed per-compiled-program HBM bytes ("hbm") captured via
-XLA memory analysis under FLAGS_device_telemetry.
+accumulators) plus a "goodput" block (the profiler.goodput wall-clock
+ledger: compile/step bucket split and the accounted fraction); the serve
+and fleet legs embed TTFT / inter-token / queue-wait percentiles, run
+their measured pass under request tracing (sample=1 — the parity gates
+prove it adds zero syncs/retraces) and embed a "trace" stage breakdown
+saying WHERE the tail lives (queue vs prefill vs decode p50/p99/share);
+the fleet leg additionally smoke-hits the live ops endpoint (OpsServer
+/healthz + /traces over HTTP, ephemeral port) while the fleet is up; the
+ckpt leg embeds save-latency percentiles; the mesh legs embed
+per-compiled-program HBM bytes ("hbm") captured via XLA memory analysis
+under FLAGS_device_telemetry.
 Set PTPU_BENCH=125m|760m|serve|paged|ckpt|fleet|mesh|mesh760m to run a
 single leg.  PTPU_FUSED_STEPS sets the fused window length K (default 4; 1
 disables the fused leg).  PTPU_MESH picks the mesh leg's axis degrees.
@@ -75,11 +82,24 @@ def _metrics_summary(logger, keys=("loss", "grad_norm", "tok_s",
             for k, s in logger.summary().items() if k in keys}
 
 
+def _goodput_summary(ledger):
+    """Compact wall-clock ledger block for the leg JSON (see
+    profiler.goodput): where every second went, and how much of it was
+    attributed to a named bucket (>=99% or the phase timings lie)."""
+    r = ledger.report()
+    return {"goodput": round(r["goodput"], 4),
+            "accounted": round(r["accounted"], 4),
+            "wall_s": round(r["wall_s"], 4),
+            "buckets_s": {k: round(v, 4)
+                          for k, v in r["buckets_s"].items() if v}}
+
+
 def _run_leg(cfg, batch, seq, iters, rounds, fused_steps=1):
     import paddle_tpu as paddle
     from paddle_tpu.io import Window
     from paddle_tpu.jit import CompiledTrainStep
     from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
+    from paddle_tpu.profiler.goodput import GoodputLedger
 
     model = GPTForCausalLM(cfg)
     crit = GPTPretrainingCriterion()
@@ -109,23 +129,29 @@ def _run_leg(cfg, batch, seq, iters, rounds, fused_steps=1):
     # structures) and window 2 as the scan compile.  compile_s covers
     # hydrate + all traces + XLA compiles; first_step_s is the first fully
     # cached dispatch; steady_step_s is the measured median.
-    t0 = time.perf_counter()
-    dispatch()
-    dispatch().numpy()
-    compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    dispatch().numpy()
-    first_step_s = time.perf_counter() - t0
+    ledger = GoodputLedger()
+    ledger.start()
+    with ledger.bucket("compile"):
+        t0 = time.perf_counter()
+        dispatch()
+        dispatch().numpy()
+        compile_s = time.perf_counter() - t0
+    with ledger.bucket("step"):
+        t0 = time.perf_counter()
+        dispatch().numpy()
+        first_step_s = time.perf_counter() - t0
 
     n_windows = max(1, iters // k)
     rates = []
     for _ in range(rounds):
-        t0 = time.perf_counter()
-        for _ in range(n_windows):
-            loss = dispatch()
-        loss.numpy()  # sync
-        dt = time.perf_counter() - t0
+        with ledger.bucket("step"):
+            t0 = time.perf_counter()
+            for _ in range(n_windows):
+                loss = dispatch()
+            loss.numpy()  # sync
+            dt = time.perf_counter() - t0
         rates.append(batch * seq * k * n_windows / dt)
+    ledger.stop()
     tokens_per_sec = float(np.median(rates))
     spread = (float(np.max(rates) - np.min(rates)) / tokens_per_sec
               if len(rates) > 1 else 0.0)
@@ -135,8 +161,9 @@ def _run_leg(cfg, batch, seq, iters, rounds, fused_steps=1):
               "steady_step_s": round(batch * seq / tokens_per_sec, 6)}
     step.metrics_flush()  # harvest pending device refs at the leg boundary
     msum = _metrics_summary(step.metrics)
+    gput = _goodput_summary(ledger)
     del step, model, opt  # free HBM before the next leg
-    return tokens_per_sec, spread, n_params, phases, msum
+    return tokens_per_sec, spread, n_params, phases, msum, gput
 
 
 def _run_ckpt_leg(cfg, batch, seq, iters, fused_steps=1,
@@ -245,8 +272,10 @@ def _run_serve_leg(cfg, n_requests=64, max_new=64, max_slots=8,
     prefill bucket); the engine run is two waves so late arrivals really
     do join slots mid-decode.  Returns the leg dict."""
     import paddle_tpu as paddle
+    from paddle_tpu.core import flags as _flags
     from paddle_tpu.models import GPTForCausalLM
     from paddle_tpu.profiler import counters
+    from paddle_tpu.profiler import trace as rtrace
     from paddle_tpu.serving import LLMEngine
     from paddle_tpu.serving.engine import bucket_length
 
@@ -282,19 +311,31 @@ def _run_serve_leg(cfg, n_requests=64, max_new=64, max_slots=8,
     for _ in eng.generate(warm, max_new_tokens=2):
         pass
     warmed_counts = {n: h.count for n, h in eng.hists.items()}
+    # measured pass runs fully traced (head sampling = keep all): the leg
+    # reports WHERE the latency tail lives (queue vs prefill vs decode),
+    # not just that it exists.  The parity gates elsewhere prove tracing
+    # adds zero syncs/retraces, so tracing the timed pass is honest.
+    rtrace.clear()
+    _flags.set_flags({"FLAGS_request_trace_sample": 1.0})
     before = counters.snapshot()
     t0 = time.perf_counter()
-    half = n_requests // 2
-    hs = [eng.add_request(p, max_new_tokens=max_new)
-          for p in prompts[:half]]
-    for _ in range(3):
-        eng.step()  # wave 1 decodes; wave 2 arrives mid-flight
-    hs += [eng.add_request(p, max_new_tokens=max_new)
-           for p in prompts[half:]]
-    while not all(h.is_finished for h in hs):
-        eng.step()
+    try:
+        half = n_requests // 2
+        hs = [eng.add_request(p, max_new_tokens=max_new)
+              for p in prompts[:half]]
+        for _ in range(3):
+            eng.step()  # wave 1 decodes; wave 2 arrives mid-flight
+        hs += [eng.add_request(p, max_new_tokens=max_new)
+               for p in prompts[half:]]
+        while not all(h.is_finished for h in hs):
+            eng.step()
+    finally:
+        _flags.set_flags({"FLAGS_request_trace_sample": 0.0})
     serve_s = time.perf_counter() - t0
     delta = counters.delta(before)
+    trace_block = {"sample": 1.0,
+                   "kept": len(rtrace.kept_ids()),
+                   "stages": rtrace.stage_breakdown()}
 
     match = all(np.array_equal(h.output_ids(), s)
                 for h, s in zip(hs[:n_verify], seq_outs))
@@ -312,7 +353,8 @@ def _run_serve_leg(cfg, n_requests=64, max_new=64, max_slots=8,
            "prefill_programs": eng.stats()["prefill_programs"],
            "ttft": _latency_ms(snap["serving.ttft_ns"]),
            "itl": _latency_ms(snap["serving.itl_ns"]),
-           "queue_wait": _latency_ms(snap["serving.queue_wait_ns"])}
+           "queue_wait": _latency_ms(snap["serving.queue_wait_ns"]),
+           "trace": trace_block}
     # the tail stats must cover the measured request set, not just warmup
     measured = snap["serving.ttft_ns"].count \
         - warmed_counts["serving.ttft_ns"]
@@ -320,6 +362,10 @@ def _run_serve_leg(cfg, n_requests=64, max_new=64, max_slots=8,
         raise AssertionError(
             f"serving leg: TTFT histogram covered {measured} measured "
             f"requests, expected {n_requests}")
+    if trace_block["kept"] < n_requests:
+        raise AssertionError(
+            f"serving leg: only {trace_block['kept']} request traces kept "
+            f"at sample=1, expected {n_requests}")
     if not match:
         raise AssertionError(
             "serving leg: engine output diverged from sequential "
@@ -523,9 +569,14 @@ def _run_fleet_leg(cfg, replicas=2, n_requests=8, max_new=32, max_slots=4,
     lost requests, respawns == injected kills, and the churn output
     token-identical to the clean run (same seeds → same streams, replayed
     across the respawn)."""
+    import urllib.request
+
     import paddle_tpu as paddle
+    from paddle_tpu.core import flags as _flags
     from paddle_tpu.models import GPTForCausalLM
     from paddle_tpu.profiler import counters
+    from paddle_tpu.profiler import trace as rtrace
+    from paddle_tpu.profiler.ops import OpsServer
     from paddle_tpu.resilience import faultinject
     from paddle_tpu.serving import ServingFleet
 
@@ -559,11 +610,27 @@ def _run_fleet_leg(cfg, replicas=2, n_requests=8, max_new=32, max_slots=4,
         return hs, dt, counters.delta(before)
 
     run_pass()  # warm timing pass (programs already compiled at spawn)
-    clean_hs, clean_s, clean_d = run_pass()
-    churn_hs, churn_s, churn_d = run_pass(kill=True)
+    # both measured passes run traced: the churn pass's respawned request
+    # keeps ONE trace_id across replicas, so the breakdown sees the full
+    # redispatch story, not two half-requests
+    rtrace.clear()
+    _flags.set_flags({"FLAGS_request_trace_sample": 1.0})
+    try:
+        clean_hs, clean_s, clean_d = run_pass()
+        churn_hs, churn_s, churn_d = run_pass(kill=True)
+    finally:
+        _flags.set_flags({"FLAGS_request_trace_sample": 0.0})
     # fleet-wide latency tail: replica histograms merged by the router
     # (dead replicas included — their delivered latency counts)
     agg = fleet.router.aggregate_histograms(fleet._replicas)
+    obs = fleet.router.observability_summary(fleet._replicas)
+    # ops-endpoint smoke: the live process plane serves this very fleet
+    # over HTTP while it is still up (ephemeral port, stdlib client)
+    with OpsServer(fleet=fleet) as srv:
+        with urllib.request.urlopen(srv.url("/healthz"), timeout=10) as r:
+            ops_health = json.loads(r.read())
+        with urllib.request.urlopen(srv.url("/traces"), timeout=10) as r:
+            ops_traces = json.loads(r.read())
     fleet.drain()
 
     match = all(c.finish_reason == "length" and k.finish_reason == "length"
@@ -587,11 +654,20 @@ def _run_fleet_leg(cfg, replicas=2, n_requests=8, max_new=32, max_slots=4,
            "outputs_match_clean": match,
            "ttft": _latency_ms(agg["serving.ttft_ns"]),
            "itl": _latency_ms(agg["serving.itl_ns"]),
-           "queue_wait": _latency_ms(agg["serving.queue_wait_ns"])}
+           "queue_wait": _latency_ms(agg["serving.queue_wait_ns"]),
+           "trace": {"kept": obs["traces_kept"],
+                     "stages": obs["stage_breakdown"]},
+           "ops": {"healthz": ops_health.get("status"),
+                   "alive": (ops_health.get("fleet") or {}).get("alive"),
+                   "traces_kept": ops_traces.get("count")}}
     if (not match or leg["lost"] != 0 or leg["respawns"] != 1
             or leg["retried"] < 1 or leg["steady_retraces"] != 0):
         raise AssertionError(
             f"fleet leg broke the durability invariants: {leg}")
+    if ops_health.get("status") != "ok" or not ops_traces.get("count"):
+        raise AssertionError(
+            f"fleet leg: live ops endpoint unhealthy or trace-blind: "
+            f"{leg['ops']}")
     del fleet, model
     return leg
 
@@ -783,21 +859,23 @@ def main():
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=128,
                         use_flash_attention=False)
-        tps, spread, _, phases, msum = _run_leg(cfg, 2, 128, 4, 1)
+        tps, spread, _, phases, msum, gput = _run_leg(cfg, 2, 128, 4, 1)
         out = {"metric": "gpt_tiny_cpu_tokens_per_sec",
                "value": round(tps, 2), "unit": "tokens/s",
                "vs_baseline": 0.0,
                "spread_frac": round(spread, 4),
                "phases": phases,
-               "metrics": msum}
+               "metrics": msum,
+               "goodput": gput}
         if fused_k > 1:
-            ftps, _, _, fphases, fmsum = _run_leg(cfg, 2, 128, 4, 1,
-                                                  fused_steps=fused_k)
+            ftps, _, _, fphases, fmsum, fgput = _run_leg(
+                cfg, 2, 128, 4, 1, fused_steps=fused_k)
             out["fused"] = {"fused_steps": fused_k,
                             "tokens_per_sec": round(ftps, 2),
                             "fused_speedup": round(ftps / tps, 4),
                             "phases": fphases,
-                            "metrics": fmsum}
+                            "metrics": fmsum,
+                            "goodput": fgput}
         # tiny serving leg: correctness gate (token identity) always; the
         # speedup number is informational on CPU
         out["serve"] = _run_serve_leg(cfg, n_requests=64, max_new=8,
@@ -844,28 +922,30 @@ def main():
                                   recompute="selective_lean")
         # rounds=4: the first post-compile round can run ~3% cold (seen in
         # r5 combined runs); the median over 4 shakes it off
-        tps, spread, n, phases, msum = _run_leg(cfg, 8, 1024, 10, 4)
+        tps, spread, n, phases, msum, gput = _run_leg(cfg, 8, 1024, 10, 4)
         legs["gpt760m"] = {"tokens_per_sec": round(tps, 2),
                            "mfu": round(tps * 6 * n / peak, 4),
                            "spread_frac": round(spread, 4),
                            "phases": phases,
-                           "metrics": msum}
+                           "metrics": msum,
+                           "goodput": gput}
     if which in ("all", "125m"):
         cfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
                                   dtype="bfloat16",
                                   use_flash_attention=True,
                                   recompute="selective")
-        tps, spread, n, phases, msum = _run_leg(cfg, 16, 1024, 15, 3)
+        tps, spread, n, phases, msum, gput = _run_leg(cfg, 16, 1024, 15, 3)
         legs["gpt125m"] = {"tokens_per_sec": round(tps, 2),
                            "mfu": round(tps * 6 * n / peak, 4),
                            "spread_frac": round(spread, 4),
                            "phases": phases,
-                           "metrics": msum}
+                           "metrics": msum,
+                           "goodput": gput}
         if fused_k > 1:
             # fused-dispatch leg: same model/config, K steps per XLA
             # launch — isolates the per-step python dispatch overhead
             # that the 125m leg is most exposed to
-            ftps, fspread, n, fphases, fmsum = _run_leg(
+            ftps, fspread, n, fphases, fmsum, fgput = _run_leg(
                 cfg, 16, 1024, 16, 3, fused_steps=fused_k)
             legs["gpt125m_fused"] = {
                 "fused_steps": fused_k,
@@ -874,7 +954,8 @@ def main():
                 "fused_speedup": round(ftps / tps, 4),
                 "spread_frac": round(fspread, 4),
                 "phases": fphases,
-                "metrics": fmsum}
+                "metrics": fmsum,
+                "goodput": fgput}
     if which in ("all", "ckpt"):
         # checkpointed-training leg: steady fused windows with async saves
         # overlapping the next window — reports ckpt_overhead_frac and

@@ -20,6 +20,20 @@ Pieces:
 * ``flight`` — always-on flight-recorder ring buffer; faults (trainer
   recovery, nan/inf raise, fleet replica death/stall) dump a postmortem
   JSON bundle (``scripts/flight_dump.py`` pretty-prints it).
+* ``trace`` — per-request distributed tracing: a ``TraceContext`` minted
+  at fleet/engine admission, lifecycle child spans (queue, KV reserve,
+  prefill chunks, decode iterations, re-prefill after respawn) recorded
+  into per-request span trees; head sampling via
+  ``FLAGS_request_trace_sample`` + tail-based keep-always for
+  deadline-breaching / errored / retried requests; JSONL and merged
+  chrome://tracing export on the host tracer's clock.
+* ``goodput`` — ``GoodputLedger``: exclusive-time wall-clock buckets
+  (compile / step / data_wait / ckpt_sync / restore_replay / recovery /
+  idle) for the FaultTolerantTrainer; goodput fraction + >=99%-accounted
+  chaos gate.
+* ``ops`` — ``OpsServer``: stdlib-HTTP live endpoint (``/metrics``,
+  ``/healthz``, ``/goodput``, ``/traces/<id>``, ``/flight``),
+  fleet-aggregated via the Router (``scripts/ops_server.py`` CLI).
 * ``Profiler`` — the paddle.profiler front end: scheduler state machine,
   ``on_trace_ready`` handlers (``export_chrome_tracing``), ``summary()``,
   and ``timer_only=True`` step benchmarking (ips + reader/batch cost split).
@@ -39,11 +53,16 @@ from enum import Enum
 
 from . import counters  # noqa: F401
 from . import flight  # noqa: F401
+from . import goodput  # noqa: F401
 from . import host_tracer  # noqa: F401
 from . import metrics  # noqa: F401
+from . import trace  # noqa: F401
+from .goodput import GoodputLedger  # noqa: F401
 from .host_tracer import current_stack, span  # noqa: F401
 from .metrics import (Histogram, MetricsLogger, memory_summary,  # noqa: F401
                       prometheus_text)
+from .ops import OpsServer  # noqa: F401
+from .trace import TraceContext  # noqa: F401
 
 
 class ProfilerTarget(Enum):

@@ -52,6 +52,14 @@ Well-known names (see README "Observability" for the full table):
   flight.dumps / flight.dumps.<reason> (postmortem bundles written)
   program.<name>.<field> (gauges: per-compiled-program HBM bytes /
       compile seconds / FLOPs under FLAGS_device_telemetry)
+  serving.fleet.slow_decode_stalls (injected slow_decode stall beats)
+  trace.started / trace.finished / trace.spans (request tracing; all 0
+      when FLAGS_request_trace_sample=0 — the zero-overhead-off gate)
+  trace.kept / trace.kept.head / trace.kept.tail / trace.dropped
+      (retention split: head sampling vs tail keep-always on
+      deadline/error/retried)
+  goodput.fraction / goodput.accounted / goodput.wall_ns /
+  goodput.<bucket>_ns (gauges: GoodputLedger.report() wall-clock split)
 
 Latency *distributions* (serving.ttft_ns, serving.itl_ns,
 serving.queue_wait_ns, io.prefetch_stall_ns, resilience.save_ms, ...)

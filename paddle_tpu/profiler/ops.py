@@ -1,0 +1,184 @@
+"""Live ops endpoint: stdlib-HTTP window into the running process.
+
+One tiny ``ThreadingHTTPServer`` (no dependencies, daemon threads) serving
+the full observability plane of a live trainer/engine/fleet:
+
+  GET /healthz            liveness + fleet/replica health (Router-aggregated)
+  GET /metrics            Prometheus text exposition (counters + gauges +
+                          histogram quantiles; ``metrics.prometheus_text``)
+  GET /goodput            the attached GoodputLedger's bucket report
+  GET /traces             kept request-trace ids + queue/prefill/decode
+                          stage breakdown (``trace.stage_breakdown``)
+  GET /traces/<trace_id>  one kept request's full span tree
+  GET /flight             flight-recorder state: last postmortem bundle
+                          path, bundle dir listing, event-ring tail
+
+Attach whatever the process has: ``OpsServer(fleet=...)`` aggregates
+across fleet replicas via the Router (health, merged latency
+histograms); ``OpsServer(engine=...)`` serves a standalone engine;
+``OpsServer(ledger=...)`` exposes a trainer's goodput.  ``port=0`` binds
+an ephemeral port (``server.port`` after :meth:`start`) so tests and
+bench smoke-hits never collide.  ``scripts/ops_server.py`` is the CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import counters as _counters
+from . import flight as _flight
+from . import metrics as _metrics
+from . import trace as _rtrace
+
+__all__ = ["OpsServer"]
+
+
+class OpsServer:
+    """Serve the ops endpoints for this process; non-blocking."""
+
+    def __init__(self, fleet=None, engine=None, ledger=None, logger=None,
+                 host="127.0.0.1", port=0):
+        self.fleet = fleet
+        self.engine = engine
+        self.ledger = ledger
+        self.logger = logger
+        self.host = host
+        self.port = int(port)
+        self._srv = None
+        self._thread = None
+
+    # -- endpoint payloads ---------------------------------------------------
+    def healthz(self):
+        out = {"status": "ok", "pid": os.getpid(),
+               "flight_dumps": _counters.get("flight.dumps"),
+               "traces_kept": len(_rtrace.kept_ids())}
+        if self.fleet is not None:
+            st = self.fleet.stats()
+            out["fleet"] = {
+                "alive": st["alive"],
+                "replicas": len(st["replicas"]),
+                "requests": st["requests"],
+                "unfinished": st["unfinished"],
+                "pending_retries": st["pending_retries"],
+                "decode_tps": st["decode_tps"],
+                "closed": st["closed"],
+                "latency": st["latency"],
+            }
+            if st["alive"] == 0 and not st["closed"]:
+                out["status"] = "degraded"
+        elif self.engine is not None:
+            out["engine"] = self.engine.stats()
+        if self.ledger is not None and self.ledger.started:
+            r = self.ledger.report(publish=False)
+            out["goodput"] = {"goodput": r["goodput"],
+                              "accounted": r["accounted"]}
+        return 200, out
+
+    def goodput(self):
+        if self.ledger is None or not self.ledger.started:
+            return 404, {"error": "no goodput ledger attached"}
+        return 200, self.ledger.report(publish=False)
+
+    def traces(self):
+        return 200, {"count": len(_rtrace.kept_ids()),
+                     "kept": _rtrace.kept_ids(),
+                     "sample_rate": _rtrace.sample_rate(),
+                     "breakdown": _rtrace.stage_breakdown()}
+
+    def trace_by_id(self, trace_id):
+        t = _rtrace.get_trace(trace_id)
+        if t is None:
+            return 404, {"error": f"unknown trace_id {trace_id!r}",
+                         "kept": _rtrace.kept_ids()}
+        return 200, t
+
+    def flight_state(self, tail=50):
+        d = _flight.dump_dir()
+        bundles = []
+        if os.path.isdir(d):
+            bundles = sorted(f for f in os.listdir(d)
+                             if f.startswith("flight-"))
+        evs = _flight.events()[-int(tail):]
+        return 200, {
+            "last_dump": _flight.last_dump_path(),
+            "dump_dir": d,
+            "bundles": bundles,
+            "events": [dict(fields, ts_ns=ts, kind=kind)
+                       for ts, kind, fields in evs],
+        }
+
+    def route(self, path):
+        """Dispatch one GET; returns (status, content_type, body_bytes)."""
+        path = path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/metrics":
+            body = _metrics.prometheus_text(self.logger)
+            return 200, "text/plain; version=0.0.4", body.encode()
+        if path in ("/", "/healthz"):
+            code, obj = self.healthz()
+        elif path == "/goodput":
+            code, obj = self.goodput()
+        elif path == "/traces":
+            code, obj = self.traces()
+        elif path.startswith("/traces/"):
+            code, obj = self.trace_by_id(path[len("/traces/"):])
+        elif path == "/flight":
+            code, obj = self.flight_state()
+        else:
+            code, obj = 404, {"error": f"unknown endpoint {path!r}",
+                              "endpoints": ["/healthz", "/metrics",
+                                            "/goodput", "/traces",
+                                            "/traces/<trace_id>",
+                                            "/flight"]}
+        return code, "application/json", json.dumps(obj).encode()
+
+    # -- server lifecycle ----------------------------------------------------
+    def start(self):
+        """Bind + serve in a daemon thread; returns the bound port."""
+        ops = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    code, ctype, body = ops.route(self.path)
+                except Exception as e:   # endpoint bug must not kill serving
+                    code, ctype = 500, "application/json"
+                    body = json.dumps({"error": repr(e)}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):    # keep stdout clean
+                pass
+
+        self._srv = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._srv.daemon_threads = True
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        name="ops-server", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def url(self, path="/healthz"):
+        return f"http://{self.host}:{self.port}{path}"
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -58,6 +58,15 @@ Well-known sites
                      callers see ``EngineBackpressure`` once the bounded
                      queue backs up.  Queried via :func:`take` (the
                      engine defers rather than raises).
+``slow_decode``      per-iteration stall of the replica decoding fleet
+                     request ``index``: the replica sleeps
+                     ``fleet.SLOW_DECODE_STALL_S`` before its decode
+                     launch (once per scheduled count) but KEEPS
+                     heartbeating — the request limps, finishes late,
+                     and its trace must name the ``decode.stall`` spans
+                     (the tail-sampling chaos site).  Queried via
+                     :func:`take` (the replica stalls rather than
+                     raises).
 ===================  ====================================================
 
 Every fired fault is appended to :data:`fired` (``(site, index)`` tuples)
@@ -115,6 +124,7 @@ _EXC = {
     "decode_stall": InjectedFault,   # consumed via take(); never raised
     "router_queue": InjectedFault,
     "kv_pool_exhausted": InjectedFault,   # consumed via take(); never raised
+    "slow_decode": InjectedFault,         # consumed via take(); never raised
 }
 
 _LOCK = threading.Lock()
@@ -231,7 +241,7 @@ _flags.define_flag(
     "Deterministic fault-injection schedule for resilience testing: "
     "'site@index[*count];...' with sites ckpt_write/ckpt_crash/preempt/"
     "loader/nan_loss/serving_prefill/replica_crash/decode_stall/"
-    "router_queue/kv_pool_exhausted (see "
+    "slow_decode/router_queue/kv_pool_exhausted (see "
     "paddle_tpu.resilience.faultinject).  Empty disables injection.")
 _flags.register_flag_observer("FLAGS_fault_schedule",
                               lambda v: set_schedule(v or None))

@@ -31,6 +31,7 @@ from ..io import DevicePrefetcher, StackingPrefetcher, Window
 from ..profiler import counters as _counters
 from ..profiler import flight as _flight
 from ..profiler import host_tracer as _trace
+from ..profiler.goodput import GoodputLedger
 from . import faultinject as _fi
 
 __all__ = ["FaultTolerantTrainer", "NonFiniteLossError"]
@@ -97,6 +98,11 @@ class FaultTolerantTrainer:
         self._epoch = 0
         self._offset = 0  # batches consumed in the current epoch
         self._last_saved = -1
+        # wall-clock goodput/badput accounting over run() (see
+        # profiler.goodput); goodput.report() after run() returns the
+        # bucket split the bench train legs embed
+        self.goodput = GoodputLedger()
+        self._compiled_once = False
 
     # -- plumbing ------------------------------------------------------------
     def _make_loader(self, epoch):
@@ -139,7 +145,8 @@ class FaultTolerantTrainer:
         # a concurrently failing async save must not mask the recovery —
         # the checkpoint set on disk is what matters now
         self.manager.wait(suppress=True)
-        info = self.manager.restore(self.step, scheduler=self.scheduler)
+        with self.goodput.bucket("restore_replay"):
+            info = self.manager.restore(self.step, scheduler=self.scheduler)
         if info is None:
             raise exc
         self._apply(info)
@@ -149,40 +156,61 @@ class FaultTolerantTrainer:
         """Train to completion, recovering from faults.  Returns the
         ``{global_step: loss}`` dict (replayed steps overwrite their own
         earlier entries with bit-identical values)."""
-        if self.manager.latest() is not None:
-            info = self.manager.restore(self.step, scheduler=self.scheduler)
-            self._apply(info)
-        else:
-            self._save(self._offset, blocking=True)  # guaranteed restore point
-        while True:
-            try:
-                self._train()
-                break
-            except self.recoverable as exc:
-                self.recoveries += 1
-                if self.recoveries > self.max_recoveries:
-                    raise
-                self._recover(exc)
-        self.manager.wait()
+        self.goodput.start()
+        try:
+            if self.manager.latest() is not None:
+                with self.goodput.bucket("restore_replay"):
+                    info = self.manager.restore(self.step,
+                                                scheduler=self.scheduler)
+                self._apply(info)
+            else:
+                with self.goodput.bucket("ckpt_sync"):
+                    self._save(self._offset,
+                               blocking=True)  # guaranteed restore point
+            while True:
+                try:
+                    self._train()
+                    break
+                except self.recoverable as exc:
+                    self.recoveries += 1
+                    if self.recoveries > self.max_recoveries:
+                        raise
+                    with self.goodput.bucket("recovery"):
+                        self._recover(exc)
+            with self.goodput.bucket("ckpt_sync"):
+                self.manager.wait()
+        finally:
+            self.goodput.stop()
         return self.losses
 
     def _done(self):
         return self.max_steps is not None and self.global_step >= self.max_steps
 
     def _train(self):
-        while self._epoch < self.epochs and not self._done():
-            loader = self._make_loader(self._epoch)
-            pref = self._make_prefetcher(loader, self._offset)
-            for item in pref:
-                self._one_window(item, pref.consumed)
-                self._offset = pref.consumed
-                if self._done():
-                    break
-            if not self._done():
-                self._epoch += 1
-                self._offset = 0
-        if self.global_step != self._last_saved:
-            self._save(self._offset, blocking=True)
+        # the whole loop runs under the "idle" bucket so scaffolding is
+        # attributed; the real work nests in data_wait / compile / step /
+        # ckpt_sync buckets (exclusive time — children pause the parent)
+        sentinel = object()
+        with self.goodput.bucket("idle"):
+            while self._epoch < self.epochs and not self._done():
+                loader = self._make_loader(self._epoch)
+                pref = self._make_prefetcher(loader, self._offset)
+                it = iter(pref)
+                while True:
+                    with self.goodput.bucket("data_wait"):
+                        item = next(it, sentinel)
+                    if item is sentinel:
+                        break
+                    self._one_window(item, pref.consumed)
+                    self._offset = pref.consumed
+                    if self._done():
+                        break
+                if not self._done():
+                    self._epoch += 1
+                    self._offset = 0
+            if self.global_step != self._last_saved:
+                with self.goodput.bucket("ckpt_sync"):
+                    self._save(self._offset, blocking=True)
 
     def _one_window(self, item, consumed_after):
         gs0 = self.global_step
@@ -194,14 +222,16 @@ class FaultTolerantTrainer:
                 item = Window(tuple(_poison_leaf(t) for t in item), item.k)
             else:
                 item = tuple(_poison_leaf(t) for t in item)
-        with _trace.span("resilience.window"):
+        bname = "step" if self._compiled_once else "compile"
+        with self.goodput.bucket(bname), _trace.span("resilience.window"):
             if isinstance(item, Window):
                 losses = self.step(item)
             elif isinstance(item, (tuple, list)):
                 losses = self.step(*item)
             else:
                 losses = self.step(item)
-        vals = np.atleast_1d(np.asarray(losses.numpy()))
+            vals = np.atleast_1d(np.asarray(losses.numpy()))
+        self._compiled_once = True
         if not np.all(np.isfinite(vals)):
             raise NonFiniteLossError(
                 f"non-finite loss at steps {gs0 + 1}..{gs0 + k}: {vals}")
@@ -213,7 +243,8 @@ class FaultTolerantTrainer:
         self.global_step = gs0 + k
         if self.save_every > 0 and \
                 self.global_step - self._last_saved >= self.save_every:
-            self._save(consumed_after)
+            with self.goodput.bucket("ckpt_sync"):
+                self._save(consumed_after)
         # fault site: preemption lands after the step (and after any
         # periodic save), like a SIGTERM between steps
         for i in range(k):

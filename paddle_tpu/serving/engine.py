@@ -46,6 +46,7 @@ import numpy as np
 from ..profiler import counters
 from ..profiler import flight
 from ..profiler import metrics
+from ..profiler import trace as rtrace
 from ..profiler.host_tracer import span
 from .sampling import filter_logits
 
@@ -104,7 +105,7 @@ class Request:
                  "temperature", "top_k", "top_p", "eos_token_id", "seed",
                  "state", "finish_reason", "tokens", "slot", "arrival_ns",
                  "last_emit_ns", "deadline", "_cancel", "_engine", "error",
-                 "tag")
+                 "tag", "trace")
 
     def __init__(self, rid, prompt, max_new_tokens, do_sample, temperature,
                  top_k, top_p, eos_token_id, seed, deadline, engine):
@@ -129,6 +130,7 @@ class Request:
         self._cancel = False
         self._engine = engine
         self.tag = None           # opaque owner backref (fleet router)
+        self.trace = None         # TraceContext when request tracing is on
 
     @property
     def is_finished(self):
@@ -399,7 +401,8 @@ class LLMEngine:
     # -- request intake ------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, do_sample=False,
                     temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                    seed=None, deadline_s=None, block=True, timeout=None):
+                    seed=None, deadline_s=None, block=True, timeout=None,
+                    trace_ctx=None):
         """Enqueue one prompt; returns the live ``Request`` handle.
 
         Backpressure: when the bounded queue is full, ``block=False``
@@ -408,7 +411,10 @@ class LLMEngine:
         ``step()`` to make room, then raises.  ``deadline_s`` is a
         per-request wall-clock budget (queue wait included); on expiry the
         request finishes with ``finish_reason='deadline'`` and whatever
-        tokens it produced."""
+        tokens it produced.  ``trace_ctx`` carries a caller-minted
+        ``TraceContext`` (the fleet threads the SAME context through
+        every retry attempt); with tracing sampled on and no context
+        given, the engine mints its own."""
         if self._closed:
             raise EngineClosed("engine is drained; no new requests")
         ids = np.asarray(
@@ -430,6 +436,10 @@ class LLMEngine:
                       bool(do_sample), float(temperature), int(top_k),
                       float(top_p), (None if eos is None else int(eos)),
                       int(seed), deadline, self)
+        req.trace = trace_ctx if trace_ctx is not None \
+            else rtrace.new_trace(req.rid)
+        if req.trace is not None:
+            req.trace.stamp("enqueue")  # queue span spans wait + queue time
         with self._cond:
             while len(self._queue) >= self.queue_size:
                 if not block:
@@ -485,6 +495,16 @@ class LLMEngine:
         flight.record("serving.finish", rid=req.rid, reason=reason,
                       tokens=len(req.tokens))
         events.append({"type": "finished", "request": req, "reason": reason})
+        tr = req.trace
+        if tr is not None:
+            tr.add_event("evict", reason=reason)
+            if req.tag is None:
+                # standalone request: the engine owns trace finalization;
+                # fleet-owned requests (tag set) are finalized by
+                # FleetRequest._finish, which sees retries/redispatches
+                breached = (req.deadline is not None
+                            and time.monotonic() > req.deadline)
+                rtrace.finish(tr, reason, breached=breached)
         return True
 
     def _sweep(self, events):
@@ -556,7 +576,11 @@ class LLMEngine:
             self._observe("serving.queue_wait_ns",
                           time.monotonic_ns() - req.arrival_ns,
                           sum_counter=True)
+            tr = req.trace
+            if tr is not None:
+                tr.span_from("enqueue", "queue")
             slot = self._free.pop()
+            t0_tr = time.perf_counter_ns() if tr is not None else 0
             try:
                 from ..resilience import faultinject as _fi
                 _fi.maybe_fault("serving_prefill", req.rid)
@@ -582,6 +606,9 @@ class LLMEngine:
                                         np.int32(slot))
                     self._ck, self._cv = ins(
                         self._ck, self._cv, kc, vc, np.int32(slot))
+                if tr is not None:
+                    tr.add_span("prefill", t0_tr, time.perf_counter_ns(),
+                                bucket=bucket, tokens=T)
             except Exception as e:
                 # a poisoned request (bad prompt, injected fault, prefill
                 # blow-up) must not kill the engine loop: contain it to
@@ -612,6 +639,8 @@ class LLMEngine:
         self._observe("serving.decode_occupancy",
                       len(active) / self.max_slots)
         t0 = time.perf_counter()
+        tr_on = rtrace.enabled()
+        t0_tr = time.perf_counter_ns() if tr_on else 0
         with span("serving.decode"):
             dec = self._decode()
             dargs = (self._w, self._ck, self._cv,
@@ -622,6 +651,12 @@ class LLMEngine:
             self._maybe_capture("serving.decode", dec, *dargs)
             nxt, self._ck, self._cv, new_keys = dec(*dargs)
             nxt = np.asarray(nxt)
+        if tr_on:
+            t1_tr = time.perf_counter_ns()
+            for _s, r in active:
+                if r.trace is not None:
+                    r.trace.add_span("decode.iter", t0_tr, t1_tr,
+                                     batch=len(active))
         self._keys = np.array(new_keys)  # mutable host copy
         inst = len(active) / max(time.perf_counter() - t0, 1e-9)
         with self._cond:

@@ -53,6 +53,7 @@ import numpy as np
 
 from ..profiler import counters
 from ..profiler import flight
+from ..profiler import trace as rtrace
 from ..profiler.host_tracer import span
 from ..resilience import faultinject
 from .engine import (EngineBackpressure, EngineClosed, LLMEngine,
@@ -60,6 +61,14 @@ from .engine import (EngineBackpressure, EngineClosed, LLMEngine,
 from .router import RetryAfter, Router
 
 __all__ = ["FleetRequest", "Replica", "ServingFleet"]
+
+# per-iteration stall applied by the ``slow_decode`` faultinject site: the
+# replica holding the scheduled fleet request sleeps this long before its
+# decode launch, once per consumed schedule entry ("slow_decode@rid*N"
+# stalls N consecutive iterations).  Long enough to dominate the request's
+# decode share in its trace; short enough to stay far from the heartbeat
+# stall detector.
+SLOW_DECODE_STALL_S = 0.02
 
 
 class FleetRequest:
@@ -74,7 +83,7 @@ class FleetRequest:
 
     __slots__ = ("rid", "prompt", "kw", "seed", "deadline_s", "deadline",
                  "state", "finish_reason", "error", "tokens", "retries",
-                 "replica_idx", "_er", "_lock", "_done", "_cancel")
+                 "replica_idx", "trace", "_er", "_lock", "_done", "_cancel")
 
     def __init__(self, rid, prompt, kw, seed, deadline_s):
         self.rid = rid
@@ -91,6 +100,7 @@ class FleetRequest:
         self.tokens = []              # authoritative delivered stream
         self.retries = 0
         self.replica_idx = None       # replica of the current attempt
+        self.trace = None             # TraceContext, stable across retries
         self._er = None               # current engine Request
         self._lock = threading.Lock()
         self._done = threading.Event()
@@ -151,6 +161,13 @@ class FleetRequest:
         self._done.set()
         counters.inc("serving.fleet.completed")
         counters.inc(f"serving.fleet.completed.{reason}")
+        if self.trace is not None:
+            # the fleet handle owns trace finalization (not any single
+            # engine attempt): it alone sees retries and the true deadline
+            breached = (self.deadline is not None
+                        and time.monotonic() > self.deadline)
+            rtrace.finish(self.trace, reason, breached=breached,
+                          retried=self.retries > 0)
         return True
 
     def __repr__(self):
@@ -311,6 +328,14 @@ class ServingFleet:
             stranded = in_flight + queued
             eng._queue.clear()
             eng._cond.notify_all()
+        # stranded traces get the death stamped before the dump snapshots
+        # them, so the bundle's span trees name the event that stranded
+        # the request (the respawn re-prefill continues the SAME trace_id)
+        for er in stranded:
+            freq = er.tag
+            tr = freq.trace if freq is not None else None
+            if tr is not None:
+                tr.add_event("replica_died", replica=rep.idx, reason=reason)
         # postmortem bundle BEFORE respawn/requeue mutate anything: names
         # the dead replica and exactly which requests it was holding
         flight.dump("replica_died", {
@@ -322,6 +347,9 @@ class ServingFleet:
             "queued_rids": [r.rid for r in queued],
             "fleet_rids": [r.tag.rid for r in stranded
                            if r.tag is not None],
+            "span_trees": [r.tag.trace.to_dict() for r in stranded
+                           if r.tag is not None
+                           and r.tag.trace is not None],
         })
         # the KV storage of a dead replica is garbage — slot arena or
         # paged block pool alike; release its HBM now
@@ -385,16 +413,28 @@ class ServingFleet:
                   top_k=int(top_k), top_p=float(top_p),
                   eos_token_id=eos_token_id)
         freq = FleetRequest(rid, ids, kw, int(seed), deadline_s)
+        freq.trace = rtrace.new_trace(rid)
         est = int(ids.shape[0]) + int(max_new_tokens)
-        rep = self.router.pick(self._candidates(), est_tokens=est,
-                               deadline_s=deadline_s, prompt=ids)
+        t0_tr = (time.perf_counter_ns() if freq.trace is not None else 0)
+        try:
+            rep = self.router.pick(self._candidates(), est_tokens=est,
+                                   deadline_s=deadline_s, prompt=ids)
+        except RetryAfter:
+            if freq.trace is not None:
+                rtrace.finish(freq.trace, "shed")
+            raise
         try:
             self._dispatch(freq, rep)
         except EngineBackpressure as e:
             # lost the queue-room race with another submitter
+            if freq.trace is not None:
+                rtrace.finish(freq.trace, "shed")
             raise RetryAfter(str(e), queue_depth=e.queue_depth,
                              retry_after_hint=e.retry_after_hint,
                              reason="backpressure") from e
+        if freq.trace is not None:
+            freq.trace.add_span("admission", t0_tr, time.perf_counter_ns(),
+                                replica=rep.idx)
         with self._lock:
             self._requests.append(freq)
         self._warm_lens.add(bucket_length(int(ids.shape[0]),
@@ -414,8 +454,12 @@ class ServingFleet:
         if freq.deadline is not None:
             left = max(0.0, freq.deadline - time.monotonic())
         er = rep.engine.add_request(freq.prompt, seed=freq.seed,
-                                    deadline_s=left, block=False, **freq.kw)
+                                    deadline_s=left, block=False,
+                                    trace_ctx=freq.trace, **freq.kw)
         er.tag = freq
+        if freq.trace is not None and freq.retries > 0:
+            freq.trace.add_event("redispatch", replica=rep.idx,
+                                 retry=freq.retries)
         with freq._lock:
             freq._er = er
             freq.replica_idx = rep.idx
@@ -461,6 +505,17 @@ class ServingFleet:
             if faultinject.take("decode_stall", freq.rid):
                 rep.hung = True      # heartbeats stop; detector must act
                 return
+            if faultinject.take("slow_decode", freq.rid):
+                # deterministic per-iteration stall: the replica limps but
+                # keeps heartbeating, so the request finishes late — the
+                # tail sampler must keep its trace naming these spans
+                t0 = time.perf_counter_ns()
+                time.sleep(SLOW_DECODE_STALL_S)
+                if freq.trace is not None:
+                    freq.trace.add_span("decode.stall", t0,
+                                        time.perf_counter_ns(),
+                                        injected=True, replica=rep.idx)
+                counters.inc("serving.fleet.slow_decode_stalls")
             faultinject.maybe_fault("replica_crash", freq.rid)
 
     def _step_replica(self, rep):

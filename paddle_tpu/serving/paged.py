@@ -45,6 +45,7 @@ import numpy as np
 from ..profiler import counters
 from ..profiler import flight
 from ..profiler import metrics
+from ..profiler import trace as rtrace
 from ..profiler.host_tracer import span
 from .engine import LLMEngine, _model_programs, bucket_length
 from .kvcache import BlockPool, PrefixCache, blocks_for_tokens
@@ -229,6 +230,8 @@ class PagedLLMEngine(LLMEngine):
         T = int(req.prompt.shape[0])
         bs = self.pool.block_size
         total = blocks_for_tokens(max(1, T + req.max_new_tokens - 1), bs)
+        tr = req.trace
+        t0_tr = time.perf_counter_ns() if tr is not None else 0
         with self._cond:
             injected = _fi.take("kv_pool_exhausted", req.rid)
             shared, cached, pnode, p = [], 0, None, 0
@@ -255,14 +258,22 @@ class PagedLLMEngine(LLMEngine):
             fresh = self.pool.alloc_n(fresh_needed)
             table = shared + fresh
             slot = self._free.pop()
+            if tr is not None:
+                tr.add_span("kv.reserve", t0_tr, time.perf_counter_ns(),
+                            blocks=len(table), shared=len(shared),
+                            cached=cached)
             if pnode is not None:
                 # copy-on-write: clone the shared partial block into the
                 # request's first private tail block before extending it
+                t0_cow = time.perf_counter_ns() if tr is not None else 0
                 cp = self._pcopy()
                 cargs = (self._pk, self._pv, np.int32(pnode.block),
                          np.int32(table[len(shared)]), np.int32(p))
                 self._maybe_capture("serving.kv.copy_block", cp, *cargs)
                 self._pk, self._pv = cp(*cargs)
+                if tr is not None:
+                    tr.add_span("cow.adopt", t0_cow,
+                                time.perf_counter_ns(), tokens=p)
                 self.pool.release(pnode.block)   # drop the match retain
                 cached += p
                 self.kv_cow_copies += 1
@@ -314,6 +325,8 @@ class PagedLLMEngine(LLMEngine):
             self._observe("serving.queue_wait_ns",
                           time.monotonic_ns() - req.arrival_ns,
                           sum_counter=True)
+            if req.trace is not None:
+                req.trace.span_from("enqueue", "queue")
 
     # -- chunked prefill, interleaved with decode ----------------------------
     def _run_chunk(self, slot, st, events):
@@ -333,6 +346,8 @@ class PagedLLMEngine(LLMEngine):
         key_data = np.asarray(
             jax.random.key_data(jax.random.key(req.seed)))
         self._observe("serving.prefill_occupancy", take_n / C)
+        tr = req.trace
+        t0_tr = time.perf_counter_ns() if tr is not None else 0
         with span("serving.prefill"):
             pf = self._pchunk_for(C)
             pargs = (self._w, jnp.asarray(ids), np.int32(start),
@@ -342,6 +357,9 @@ class PagedLLMEngine(LLMEngine):
                      np.int32(req.top_k), np.float32(req.top_p))
             self._maybe_capture(f"serving.prefill_paged[c{C}]", pf, *pargs)
             self._pk, self._pv, tok, new_key = pf(*pargs)
+        if tr is not None:
+            tr.add_span("prefill.chunk", t0_tr, time.perf_counter_ns(),
+                        chunk=C, start=start, take=take_n)
         counters.inc("serving.kv.prefill_chunks")
         st["done"] = start + take_n
         if last:
@@ -395,6 +413,8 @@ class PagedLLMEngine(LLMEngine):
                           0).astype(np.int32)
         pos_eff = np.where(self._running, self._pos, 0).astype(np.int32)
         t0 = time.perf_counter()
+        tr_on = rtrace.enabled()
+        t0_tr = time.perf_counter_ns() if tr_on else 0
         with span("serving.decode"):
             dec = self._pdecode()
             dargs = (self._w, self._pk, self._pv, jnp.asarray(bt_eff),
@@ -405,6 +425,12 @@ class PagedLLMEngine(LLMEngine):
             self._maybe_capture("serving.decode_paged", dec, *dargs)
             nxt, self._pk, self._pv, new_keys = dec(*dargs)
             nxt = np.asarray(nxt)
+        if tr_on:
+            t1_tr = time.perf_counter_ns()
+            for _s, r in active:
+                if r.trace is not None:
+                    r.trace.add_span("decode.iter", t0_tr, t1_tr,
+                                     batch=len(active))
         self._keys = np.array(new_keys)  # mutable host copy
         inst = len(active) / max(time.perf_counter() - t0, 1e-9)
         with self._cond:

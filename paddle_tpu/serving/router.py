@@ -88,6 +88,21 @@ class Router:
         return {n: h.summary()
                 for n, h in Router.aggregate_histograms(replicas).items()}
 
+    @staticmethod
+    def observability_summary(replicas):
+        """One merged observability view over the fleet: the latency
+        summary above plus the kept request-trace stage breakdown (which
+        hop — queue / prefill / decode — ate the tail; empty when request
+        tracing is off).  The ops endpoint and the bench fleet leg both
+        read this instead of re-aggregating per replica."""
+        from ..profiler import trace as rtrace
+        return {
+            "latency": Router.latency_summary(replicas),
+            "traces_kept": len(rtrace.kept_ids()),
+            "trace_sample_rate": rtrace.sample_rate(),
+            "stage_breakdown": rtrace.stage_breakdown(),
+        }
+
     def pick(self, replicas, est_tokens=0, deadline_s=None, shed=True,
              prompt=None):
         """Choose a replica for a request costing ``est_tokens`` decode

@@ -26,6 +26,12 @@ must leave exactly one flight dump (reason ``trainer_recover``) whose
 context names the ``NonFiniteLossError``, while the run itself still
 finishes with finite losses.
 
+A goodput phase runs a tiny ``FaultTolerantTrainer`` twice — clean, and
+under an injected preemption — and gates the wall-clock ledger
+(``profiler.goodput``): in both runs >=99% of wall time must land in a
+named bucket, and the preempted run must actually fill the ``recovery``
+and ``restore_replay`` badput buckets.
+
 A serving phase runs mixed-length staggered requests through
 ``serving.LLMEngine`` and asserts the outputs are TOKEN-IDENTICAL to
 sequential per-request ``GPT.generate``; it reports decode tokens/s for
@@ -175,6 +181,40 @@ def run():
         "flight_dump_events": len(fr_bundle.get("events", [])),
     }
 
+    # ---- goodput ledger: >=99% of trainer wall time lands in a named
+    # bucket, on a clean run AND under an injected preemption (where the
+    # recovery / restore_replay buckets must actually fill) -------------
+    def _goodput_run(schedule=None):
+        paddle.seed(0)
+        gnet = nn.Sequential(nn.Linear(6, 12), nn.GELU(), nn.Linear(12, 3))
+        g_opt = paddle.optimizer.AdamW(5e-2, parameters=gnet.parameters())
+        g_step = pjit.CompiledTrainStep(gnet, _mse, g_opt)
+        with tempfile.TemporaryDirectory() as gdir:
+            gtrainer = FaultTolerantTrainer(
+                g_step, lambda epoch: DataLoader(fr_ds, batch_size=4,
+                                                 shuffle=False),
+                _CkptMgr(os.path.join(gdir, "ckpt"), keep_last=2),
+                epochs=1, max_steps=6, save_every=2)
+            if schedule:
+                with faultinject.fault_schedule(schedule):
+                    gtrainer.run()
+            else:
+                gtrainer.run()
+        return gtrainer.goodput.report()
+
+    g_clean = _goodput_run()
+    g_fault = _goodput_run("preempt@3")
+    goodput_phase = {
+        "goodput_clean_accounted": round(g_clean["accounted"], 4),
+        "goodput_clean_fraction": round(g_clean["goodput"], 4),
+        "goodput_fault_accounted": round(g_fault["accounted"], 4),
+        "goodput_fault_fraction": round(g_fault["goodput"], 4),
+        "goodput_fault_recovery_s":
+            round(g_fault["buckets_s"].get("recovery", 0.0), 4),
+        "goodput_fault_restore_s":
+            round(g_fault["buckets_s"].get("restore_replay", 0.0), 4),
+    }
+
     # ---- serving: engine output must match sequential generate ----------
     from paddle_tpu.serving import LLMEngine
 
@@ -322,6 +362,7 @@ def run():
               "paged_cow_copies": pdelta.get("serving.kv.cow_copies", 0),
               "serve_prefill_programs": eng.stats()["prefill_programs"]}
     result.update(flight_phase)
+    result.update(goodput_phase)
     result.update(mesh_phase)
     print(json.dumps(result))
     if sum(host_delta.values()) != 0:
@@ -372,6 +413,17 @@ def run():
             "injected NaN fault did not produce a flight-recorder "
             f"postmortem (or the recovery was unclean): {flight_phase}, "
             f"dump={fr_dump_path}")
+    if goodput_phase["goodput_clean_accounted"] < 0.99 or \
+            goodput_phase["goodput_fault_accounted"] < 0.99:
+        raise AssertionError(
+            "goodput ledger failed to account >=99% of trainer wall time: "
+            f"clean {goodput_phase['goodput_clean_accounted']}, "
+            f"faulted {goodput_phase['goodput_fault_accounted']}")
+    if goodput_phase["goodput_fault_recovery_s"] <= 0 or \
+            goodput_phase["goodput_fault_restore_s"] <= 0:
+        raise AssertionError(
+            "preempted run left the recovery / restore_replay goodput "
+            f"buckets empty: {goodput_phase}")
     if not outputs_match:
         raise AssertionError(
             "serving engine output diverged from sequential GPT.generate "

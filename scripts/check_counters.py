@@ -60,6 +60,16 @@ inside the measured window) — and the ``jit.syncs`` / ``jit.traces`` /
 in-graph metric accumulation and host-side harvesting add zero syncs,
 zero retraces, zero extra dispatches.
 
+An eighth phase gates request tracing (``profiler.trace``) the same two
+ways: with ``FLAGS_request_trace_sample=0`` a fresh serving + paged +
+fleet workload must move ZERO ``trace.*`` counters and must be
+counter-identical (same parity keys: zero extra retraces / hydrates /
+host dispatches / syncs) to the tracing-ON run of the identical
+workload; with sample=1, every finished engine request's stage spans
+(queue + prefill + decode) must sum within tolerance of its measured
+TTFT + decode wall clock — the span tree accounts for the latency the
+histograms report.
+
 Prints one JSON line; raises AssertionError on any violation.  Wired as a
 tier-1 test via tests/test_profiler.py.  Run directly:
 ``python scripts/check_counters.py``.
@@ -570,6 +580,77 @@ def run():
         if on != off:
             violations[f"metrics-parity:{pname}"] = (on, off)
 
+    # ---- trace gate: request tracing OFF is zero-overhead (no trace.*
+    # movement, counter-identical parity keys vs the ON run of the same
+    # fresh workload); ON, every finished engine request's stage spans
+    # must account its measured TTFT + decode wall time.
+    from paddle_tpu.core import flags as pflags
+    from paddle_tpu.profiler import trace as rtrace
+
+    def trace_workloads():
+        """Fresh slot + paged engines + a sync fleet over identical
+        deterministic workloads; returns (delta, engine handles)."""
+        paddle.seed(0)
+        rngt = np.random.RandomState(11)
+        e3 = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4)
+        p3 = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4,
+                       kv_layout="paged", block_size=4, prefill_chunk=8)
+
+        def sv(e_, lens):
+            hs = [e_.add_request(rngt.randint(0, 64, size=n).tolist(),
+                                 max_new_tokens=3) for n in lens]
+            while not all(h.is_finished for h in hs):
+                e_.step()
+            return hs
+
+        sv(e3, SERVE_LENS_WARM)
+        sv(p3, SERVE_LENS_WARM)
+        fl3 = ServingFleet(smodel, replicas=2, max_slots=2, max_seq_len=32,
+                           min_bucket=4, threaded=False,
+                           warm_buckets=SERVE_LENS_WARM)
+        b = counters.snapshot()
+        hs = sv(e3, SERVE_LENS_MEASURE) + sv(p3, SERVE_LENS_MEASURE)
+        fhs3 = [fl3.submit(rngt.randint(0, 64, size=n).tolist(),
+                           max_new_tokens=3) for n in SERVE_LENS_MEASURE]
+        fl3.join(fhs3)
+        d = counters.delta(b)
+        fl3.drain()
+        return d, hs, fhs3
+
+    pflags.set_flags({"FLAGS_request_trace_sample": 0.0})
+    toff, _, _ = trace_workloads()
+    off_moved = {k: v for k, v in toff.items()
+                 if k.startswith("trace.") and v}
+    if off_moved:
+        violations["trace-off:counters"] = (off_moved, {})
+    pflags.set_flags({"FLAGS_request_trace_sample": 1.0})
+    try:
+        ton, ths, tfhs = trace_workloads()
+    finally:
+        pflags.set_flags({"FLAGS_request_trace_sample": 0.0})
+    for k in PARITY_KEYS:
+        if ton.get(k, 0) != toff.get(k, 0):
+            violations[f"trace-parity:{k}"] = (ton.get(k, 0),
+                                               toff.get(k, 0))
+    # every measured request (4 engine + 2 fleet) finalized a trace
+    if ton.get("trace.finished", 0) < len(ths) + len(tfhs):
+        violations["trace-on:finished"] = (
+            ton.get("trace.finished", 0), f">={len(ths) + len(tfhs)}")
+    # span accounting: stage spans (queue + prefill + decode) sum within
+    # loose tolerance of the measured arrival -> last-emit wall clock;
+    # the lower bound allows the other slot's prefill to interleave, the
+    # upper allows queue/kv.reserve overlap in the paged admit path
+    trace_ratios = {}
+    for i, h in enumerate(ths):
+        lay = "slots" if i < len(SERVE_LENS_MEASURE) else "paged"
+        measured = max(1, (h.last_emit_ns or h.arrival_ns) - h.arrival_ns)
+        ratio = sum(h.trace.stage_ns().values()) / measured
+        trace_ratios[f"{lay}:r{h.rid}"] = round(ratio, 3)
+        if not 0.2 <= ratio <= 1.3:
+            violations[f"trace-span-sum:{lay}:r{h.rid}"] = (round(ratio, 3),
+                                                            "[0.2, 1.3]")
+    rtrace.clear()
+
     result = {"metric": "steady_state_counter_violations",
               "value": len(violations),
               "unit": f"violations/{MEASURE} steps "
@@ -595,7 +676,11 @@ def run():
                                     if k.startswith(("jit.", "resilience."))},
               "fault_delta": {k: v for k, v in rsteady.items()
                               if k.startswith("resilience.")},
-              "metrics_parity": metrics_parity}
+              "metrics_parity": metrics_parity,
+              "trace_parity": {"off": _pick(toff), "on": _pick(ton),
+                               "off_trace_moved": off_moved,
+                               "on_finished": ton.get("trace.finished", 0)},
+              "trace_span_ratios": trace_ratios}
     print(json.dumps(result))
     if violations:
         raise AssertionError(

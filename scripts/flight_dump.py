@@ -45,6 +45,30 @@ def _fmt_val(v):
     return str(v)
 
 
+def _render_span_tree(t, w):
+    """Indented nested rendering of one request trace embedded in a
+    serving-fault bundle (``trace.TraceContext.to_dict()`` shape)."""
+    stages = t.get("stage_ns") or {}
+    stage_txt = " ".join(f"{k}={v / 1e6:.2f}ms"
+                         for k, v in stages.items() if v)
+    w(f"  trace {t.get('trace_id')} rid={t.get('rid')} "
+      f"status={t.get('status')} keep={t.get('keep_reason')}"
+      + (f"  [{stage_txt}]" if stage_txt else "") + "\n")
+
+    def walk(node, depth):
+        dur = node.get("dur_ns", 0) / 1e6
+        extra = node.get("extra") or {}
+        detail = " ".join(f"{k}={_fmt_val(v)}" for k, v in extra.items())
+        w(f"  {'  ' * depth}{node.get('name'):<{max(1, 34 - 2 * depth)}}"
+          f"{dur:>10.3f}ms" + (f"  {detail}" if detail else "") + "\n")
+        for c in node.get("children", []):
+            walk(c, depth + 1)
+
+    root = t.get("tree")
+    if root:
+        walk(root, 1)
+
+
 def render(path, max_events=40, raw=False, out=sys.stdout):
     with open(path) as f:
         bundle = json.load(f)
@@ -57,13 +81,18 @@ def render(path, max_events=40, raw=False, out=sys.stdout):
     w(f"== flight bundle {path}\n")
     w(f"reason   : {bundle.get('reason')}\n")
     w(f"pid      : {bundle.get('pid')}   ts: {bundle.get('ts')}\n")
-    ctx = bundle.get("context") or {}
+    ctx = dict(bundle.get("context") or {})
+    span_trees = ctx.pop("span_trees", None)
     if ctx:
         w("context  :\n")
         for k in sorted(ctx):
             w(f"  {k:<18} {_fmt_val(ctx[k])}\n")
     spans = bundle.get("spans") or []
     w(f"spans    : {' > '.join(spans) if spans else '(none active)'}\n")
+    if span_trees:
+        w(f"\n-- request span trees ({len(span_trees)}):\n")
+        for t in span_trees:
+            _render_span_tree(t, w)
 
     moved = {k: v for k, v in (bundle.get("counters_delta") or {}).items()
              if v}

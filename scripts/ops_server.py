@@ -1,0 +1,116 @@
+"""Stand up the live ops endpoint (``paddle_tpu.profiler.ops.OpsServer``).
+
+Usage::
+
+    python scripts/ops_server.py --port 8321            # bare process plane
+    python scripts/ops_server.py --demo                 # + tiny fleet traffic
+    python scripts/ops_server.py --demo --trace-sample 1.0 --duration 30
+
+Serves on ``127.0.0.1``:
+
+  /healthz  /metrics  /goodput  /traces  /traces/<trace_id>  /flight
+
+With ``--demo`` a tiny 2-replica ``ServingFleet`` over a toy GPT runs
+request traffic in the background (request tracing on at
+``--trace-sample``), so every endpoint has live data to show; without it
+the endpoints expose whatever the process has recorded (counters and the
+flight ring are always live).  Runs for ``--duration`` seconds (0 = until
+Ctrl-C), then prints each endpoint's status line and exits.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _demo_fleet(trace_sample):
+    """A tiny fleet + a background submitter thread; returns (fleet, stop)."""
+    import numpy as np
+
+    from paddle_tpu.core import flags
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import RetryAfter, ServingFleet
+
+    flags.set_flags({"FLAGS_request_trace_sample": float(trace_sample)})
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=64)
+    fleet = ServingFleet(GPTForCausalLM(cfg), replicas=2, max_slots=4,
+                         min_bucket=4, threaded=True, warm_buckets=(8,))
+    stop = threading.Event()
+
+    def _traffic():
+        rng = np.random.RandomState(0)
+        while not stop.is_set():
+            prompt = rng.randint(1, 64, size=rng.randint(4, 12)).astype("int32")
+            try:
+                fleet.submit(prompt, max_new_tokens=8,
+                             seed=int(rng.randint(2**31)))
+            except RetryAfter:
+                pass
+            stop.wait(0.05)
+
+    threading.Thread(target=_traffic, name="ops-demo-traffic",
+                     daemon=True).start()
+    return fleet, stop
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--port", type=int, default=8321,
+                    help="bind port (0 = ephemeral; printed at startup)")
+    ap.add_argument("--demo", action="store_true",
+                    help="run a tiny traced serving fleet so the endpoints "
+                         "have live data")
+    ap.add_argument("--trace-sample", type=float, default=1.0,
+                    help="FLAGS_request_trace_sample for --demo traffic")
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="seconds to serve (0 = until Ctrl-C)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.profiler.ops import OpsServer
+
+    fleet = stop = None
+    if args.demo:
+        fleet, stop = _demo_fleet(args.trace_sample)
+    srv = OpsServer(fleet=fleet, port=args.port)
+    port = srv.start()
+    print(f"ops endpoint live at http://127.0.0.1:{port}  "
+          "(/healthz /metrics /goodput /traces /flight)")
+    try:
+        if args.duration > 0:
+            time.sleep(args.duration)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if stop is not None:
+            stop.set()
+        for path in ("/healthz", "/metrics", "/goodput", "/traces",
+                     "/flight"):
+            try:
+                with urllib.request.urlopen(srv.url(path), timeout=5) as r:
+                    body = r.read()
+                    line = (body.decode().splitlines() or [""])[0] \
+                        if path == "/metrics" else \
+                        json.dumps(json.loads(body))[:100]
+                    print(f"  {r.status} {path:<10} {line}")
+            except urllib.error.HTTPError as e:
+                # /goodput is 404 without an attached trainer ledger
+                print(f"  {e.code} {path:<10} {e.read().decode()[:100]}")
+            except Exception as e:  # noqa: BLE001 — summary must not crash
+                print(f"  ERR {path:<10} {e}", file=sys.stderr)
+        if fleet is not None:
+            fleet.drain()
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()
