@@ -41,6 +41,7 @@ from .tensor.random import (bernoulli, binomial, get_rng_state, multinomial,  # 
 
 # subsystems
 from . import amp  # noqa: F401
+from . import analysis  # noqa: F401
 from . import audio  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401

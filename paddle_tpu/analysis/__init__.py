@@ -1,0 +1,37 @@
+"""Static analysis: program-invariant auditor + TPU-hazard linter.
+
+Two passes over two representations of the same invariants:
+
+* :mod:`paddle_tpu.analysis.program_audit` — **pass 1**, on the
+  jaxpr/lowered module: AOT-verify donation aliasing, host-callback and
+  collective censuses, static shapes, dtype policy, and HBM budgets for
+  every compiled program, hooked into the ``jit.CompiledTrainStep`` and
+  serving compile sites behind ``FLAGS_program_audit=off|warn|enforce``.
+* :mod:`paddle_tpu.analysis.lint` — **pass 2**, on the source AST: rules
+  PT001–PT006 for the hazards that produce those broken programs in the
+  first place (host syncs in traced code, retrace traps, the
+  donation-ternary precedence bug, nondeterminism under trace, locks held
+  across dispatch, undocumented counter names).  CLI:
+  ``python scripts/lint_tpu.py --check``.
+
+Reference analogue: ``PADDLE_ENFORCE_*`` + the PIR pass-and-verify
+pipelines (SURVEY §"IR passes / program validation") — check the program,
+not the execution.
+"""
+
+from __future__ import annotations
+
+from .lint import (LintFinding, RULES, default_targets,  # noqa: F401
+                   documented_counter_patterns, fingerprint, lint_file,
+                   lint_paths, lint_source, load_baseline, save_baseline)
+from .program_audit import (AuditReport, Finding,  # noqa: F401
+                            ProgramAuditError, audit_enabled, audit_mode,
+                            audit_program, maybe_audit, reset_audited)
+
+__all__ = [
+    "AuditReport", "Finding", "ProgramAuditError", "audit_enabled",
+    "audit_mode", "audit_program", "maybe_audit", "reset_audited",
+    "LintFinding", "RULES", "default_targets",
+    "documented_counter_patterns", "fingerprint", "lint_file", "lint_paths",
+    "lint_source", "load_baseline", "save_baseline",
+]

@@ -1,0 +1,558 @@
+"""Pass 2 — TPU-hazard linter: AST rules for this codebase's perf invariants.
+
+Reference analogue: the static program checks of the PIR pass pipeline
+(SURVEY §"IR passes / program validation") applied at the *source* level —
+the hazards that cost a bench run to discover dynamically are mostly
+visible in the AST.
+
+Rules (all specific to the jax-on-TPU idioms this repo lives by):
+
+  PT001  host-sync in traced code — ``.item()`` / ``.numpy()`` /
+         ``.tolist()`` / ``.block_until_ready()`` / ``float()/int()/bool()``
+         on non-shape values / ``np.asarray``/``np.array`` inside a
+         function that is traced (jitted, scanned, vmapped, ...).  Each of
+         these either fails at trace time or, worse, silently forces a
+         device→host sync per step.
+  PT002  retrace hazards — ``jax.jit(f)(x)`` in call position
+         (compile-and-discard: a fresh cache entry per call) and
+         unhashable values (list/dict/set literals or comprehensions) used
+         as keys into a ``*_jits`` / ``*_cache`` / ``*_programs`` compile
+         cache.
+  PT003  donation-ternary precedence trap —
+         ``donate_argnums=donate + (7,) if donate else ()`` parses as
+         ``(donate + (7,)) if donate else ()``; flagged whenever a
+         ``donate_argnums``/``static_argnums`` keyword value is a ternary
+         whose branch is itself a binary expression.  Write
+         ``donate + ((7,) if donate else ())``.
+  PT004  nondeterminism in traced code — ``time.*`` / ``random.*`` /
+         ``np.random.*`` / ``datetime.*`` calls inside a traced function
+         bake a trace-time constant into the compiled program (and make
+         replay/determinism gates lie).
+  PT005  lock held across device dispatch — inside a ``with self._lock/
+         _cond:`` block: calls to ``jax.*``/``jnp.*``, to
+         ``.block_until_ready()``, or to a compiled-program variable
+         obtained from a program-getter; the threaded fleet serializes on
+         these for the full device latency.
+  PT006  counter-name discipline — first argument of ``counters.inc`` /
+         ``counters.set_gauge`` must match the documented name table in
+         ``profiler/counters.py``'s docstring (wildcard rows like
+         ``dist.<op>`` match any segment; f-strings are checked by their
+         static prefix).
+
+Suppression syntax (on the flagged line or the line above)::
+
+    # ptlint: disable=PT001 reason="host mirror, outside measured window"
+
+A suppression **must** carry a non-empty ``reason="..."`` — without one the
+finding stays active.  ``scripts/lint_tpu.py --check`` gates the repo
+against ``scripts/lint_baseline.json`` (goal: empty baseline).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+RULES = {
+    "PT001": "host-sync in traced code",
+    "PT002": "retrace hazard (compile-and-discard jit / unhashable cache key)",
+    "PT003": "donation-ternary precedence trap",
+    "PT004": "nondeterminism in traced code",
+    "PT005": "lock held across device dispatch",
+    "PT006": "undocumented counter name",
+}
+
+# Callables whose function-valued arguments run under trace.
+_TRACE_ENTRY_NAMES = frozenset({
+    "jit", "pjit", "scan", "vmap", "pmap", "grad", "value_and_grad",
+    "cond", "while_loop", "fori_loop", "switch", "pallas_call",
+    "checkpoint", "remat", "shard_map", "to_static",
+})
+# Decorators that make the decorated def a traced region.
+_TRACE_DECORATORS = frozenset({"jit", "pjit", "to_static"})
+
+_HOST_SYNC_ATTRS = frozenset({"item", "numpy", "tolist", "block_until_ready"})
+_SHAPE_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+_NONDET_ROOTS = frozenset({"time", "random", "datetime"})
+_CACHE_NAME_RE = re.compile(r"(_jits|_cache|_caches|_programs|cache)$")
+_LOCK_NAME_RE = re.compile(r"(^|[._])(lock|cond|mutex|rlock)s?$", re.IGNORECASE)
+_PROGRAM_GETTER_RE = re.compile(
+    r"^_?(p|jit|prefill|insert|decode|chunk|copy|compile)")
+_DONATE_KEYWORDS = frozenset({
+    "donate_argnums", "static_argnums", "donate_argnames", "static_argnames"})
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ptlint:\s*disable=([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+    r'(?:\s+reason="([^"]*)")?')
+
+
+@dataclass
+class LintFinding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    suppressed: bool = False
+    reason: str = ""
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message}{tag}")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _dotted(node) -> list:
+    """['jax','jit'] for ``jax.jit``; [] when the chain isn't Name/Attribute."""
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def _callee_last(call: ast.Call) -> str:
+    parts = _dotted(call.func)
+    return parts[-1] if parts else ""
+
+
+def _contains(node, pred) -> bool:
+    return any(pred(n) for n in ast.walk(node))
+
+
+def _snippet(lines, lineno) -> str:
+    if 1 <= lineno <= len(lines):
+        return lines[lineno - 1].strip()
+    return ""
+
+
+def _iter_body_skip_defs(node):
+    """Walk ``node`` without descending into nested function/lambda bodies
+    (those are linted independently iff they are themselves traced)."""
+    stack = [node]
+    first = True
+    while stack:
+        n = stack.pop()
+        if not first and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        first = False
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ---------------------------------------------------------------------------
+# documented counter names (PT006)
+# ---------------------------------------------------------------------------
+
+_DOC_NAME_RE = re.compile(r"[a-zA-Z_][\w<>]*(?:\.[\w<>]+|\[\.[\w<>]+\])+")
+_counter_doc_cache: list | None = None
+
+
+def documented_counter_patterns(doc: str | None = None) -> list:
+    """[(regex, literal_prefix)] parsed from the counters.py docstring.
+
+    ``<seg>`` is a wildcard; ``[.<seg>]`` an optional trailing segment."""
+    global _counter_doc_cache
+    if doc is None:
+        if _counter_doc_cache is not None:
+            return _counter_doc_cache
+        from ..profiler import counters as _counters
+        doc = _counters.__doc__ or ""
+    out = []
+    for token in set(_DOC_NAME_RE.findall(doc)):
+        variants = {token.replace("[", "").replace("]", "")}
+        if "[" in token:
+            variants.add(re.sub(r"\[[^\]]*\]", "", token))
+        for name in variants:
+            prefix = name.split("<")[0]
+            rx = "".join(
+                r"[A-Za-z0-9_\-]+" if piece.startswith("<") else
+                re.escape(piece)
+                for piece in re.split(r"(<[^>]*>)", name))
+            out.append((re.compile(rx + r"$"), prefix))
+    if doc is not None and _counter_doc_cache is None:
+        _counter_doc_cache = out
+    return out
+
+
+def _counter_name_ok(name: str, is_prefix: bool, patterns) -> bool:
+    for rx, lit_prefix in patterns:
+        if not is_prefix and rx.match(name):
+            return True
+        if is_prefix and (name.startswith(lit_prefix)
+                          or lit_prefix.startswith(name)):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# per-file linter
+# ---------------------------------------------------------------------------
+
+class _FileLint:
+    def __init__(self, src: str, path: str, counter_patterns=None):
+        self.src = src
+        self.path = path
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src)
+        self.findings: list = []
+        self.counter_patterns = counter_patterns
+        self.suppressions = self._parse_suppressions()
+        self.def_map: dict = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.def_map.setdefault(node.name, []).append(node)
+
+    # -- suppressions ------------------------------------------------------
+    def _parse_suppressions(self) -> dict:
+        sup = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                sup[i] = (rules, (m.group(2) or "").strip())
+        return sup
+
+    def _emit(self, rule, node, message):
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        suppressed, reason = False, ""
+        for lno in (line, line - 1):
+            entry = self.suppressions.get(lno)
+            if entry and rule in entry[0]:
+                if entry[1]:
+                    suppressed, reason = True, entry[1]
+                else:
+                    message += (" [suppression ignored: missing "
+                                'reason="..."]')
+                break
+        self.findings.append(LintFinding(
+            rule=rule, path=self.path, line=line, col=col, message=message,
+            snippet=_snippet(self.lines, line), suppressed=suppressed,
+            reason=reason))
+
+    # -- traced-region discovery ------------------------------------------
+    def _traced_regions(self) -> list:
+        roots: list = []
+        seen: set = set()
+
+        def add(node):
+            if id(node) not in seen:
+                seen.add(id(node))
+                roots.append(node)
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    parts = _dotted(target)
+                    if parts and parts[-1] in _TRACE_DECORATORS:
+                        add(node)
+            elif isinstance(node, ast.Call):
+                if _callee_last(node) in _TRACE_ENTRY_NAMES:
+                    cands = list(node.args) + [k.value for k in node.keywords]
+                    for arg in cands:
+                        if isinstance(arg, ast.Lambda):
+                            add(arg)
+                        elif (isinstance(arg, ast.Name)
+                              and arg.id in self.def_map):
+                            for d in self.def_map[arg.id]:
+                                add(d)
+        # transitive closure: helpers called from traced code are traced too
+        frontier = list(roots)
+        while frontier:
+            region = frontier.pop()
+            body = region.body if isinstance(region, ast.Lambda) else region
+            for n in _iter_body_skip_defs(body):
+                if isinstance(n, ast.Call):
+                    name = _callee_last(n)
+                    for d in self.def_map.get(name, []):
+                        if id(d) not in seen:
+                            seen.add(id(d))
+                            roots.append(d)
+                            frontier.append(d)
+        return roots
+
+    # -- rule bodies -------------------------------------------------------
+    def _check_traced_body(self, region):
+        body = region.body if isinstance(region, ast.Lambda) else region
+        fname = getattr(region, "name", "<lambda>")
+        for node in _iter_body_skip_defs(body):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = _dotted(node.func)
+            last = parts[-1] if parts else ""
+            # PT001: explicit sync methods
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _HOST_SYNC_ATTRS:
+                self._emit("PT001", node,
+                           f"`.{node.func.attr}()` in traced `{fname}` "
+                           "forces a device->host sync (or fails to trace)")
+            # PT001: float()/int()/bool() on non-shape values
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int", "bool") and node.args:
+                arg = node.args[0]
+                shapey = isinstance(arg, ast.Constant) or _contains(
+                    arg, lambda n: (isinstance(n, ast.Attribute)
+                                    and n.attr in _SHAPE_ATTRS)
+                    or (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Name)
+                        and n.func.id == "len"))
+                if not shapey:
+                    self._emit(
+                        "PT001", node,
+                        f"`{node.func.id}(...)` on a possibly-traced value "
+                        f"in traced `{fname}` is a host sync; keep it on "
+                        "device or derive from .shape")
+            # PT001: numpy materialization
+            elif (len(parts) == 2 and parts[0] in ("np", "numpy", "onp")
+                  and parts[1] in ("asarray", "array")):
+                self._emit("PT001", node,
+                           f"`{'.'.join(parts)}(...)` in traced `{fname}` "
+                           "materializes on host; use jnp instead")
+            # PT004: nondeterministic host state baked into the trace
+            if parts and parts[0] in _NONDET_ROOTS:
+                self._emit("PT004", node,
+                           f"`{'.'.join(parts)}(...)` in traced `{fname}` "
+                           "bakes a trace-time constant into the program; "
+                           "thread it in as an argument / use jax.random")
+            elif (len(parts) >= 2 and parts[0] in ("np", "numpy")
+                  and parts[1] == "random"):
+                self._emit("PT004", node,
+                           f"`{'.'.join(parts)}(...)` in traced `{fname}` "
+                           "is nondeterministic at trace time; use "
+                           "jax.random with a threaded key")
+
+    def _check_pt002(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Call):
+                if _callee_last(node.func) in ("jit", "pjit"):
+                    self._emit(
+                        "PT002", node,
+                        "`jit(f)(...)` in call position compiles and "
+                        "discards — every call is a fresh cache entry; "
+                        "bind the jitted callable once and reuse it")
+            elif isinstance(node, ast.Subscript):
+                base = _dotted(node.value)
+                if base and _CACHE_NAME_RE.search(base[-1]):
+                    key = node.slice
+                    if _contains(key, lambda n: isinstance(
+                            n, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                ast.SetComp, ast.DictComp,
+                                ast.GeneratorExp))):
+                        self._emit(
+                            "PT002", node,
+                            f"unhashable key into compile cache "
+                            f"`{'.'.join(base)}` — lists/dicts/sets in the "
+                            "cache key raise TypeError or defeat caching; "
+                            "use tuples of hashables")
+
+    def _check_pt003(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg in _DONATE_KEYWORDS \
+                        and isinstance(kw.value, ast.IfExp) \
+                        and (isinstance(kw.value.body, ast.BinOp)
+                             or isinstance(kw.value.orelse, ast.BinOp)):
+                    self._emit(
+                        "PT003", kw.value,
+                        f"`{kw.arg}=A + B if c else d` parses as "
+                        f"`(A + B) if c else d` — the conditional applies "
+                        "to the whole sum; write "
+                        f"`{kw.arg}=A + (B if c else d)`")
+
+    def _check_pt005(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.With):
+                continue
+            lockish = None
+            for item in node.items:
+                parts = _dotted(item.context_expr)
+                joined = ".".join(parts)
+                if parts and _LOCK_NAME_RE.search(joined):
+                    lockish = joined
+                    break
+            if lockish is None:
+                continue
+            program_vars: set = set()
+            for n in _iter_body_skip_defs(node):
+                if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                    vparts = _dotted(n.value.func)
+                    if vparts and (_PROGRAM_GETTER_RE.match(vparts[-1])
+                                   or vparts[-1] in ("jit", "pjit")):
+                        for tgt in n.targets:
+                            for t in ast.walk(tgt):
+                                if isinstance(t, ast.Name):
+                                    program_vars.add(t.id)
+            for n in _iter_body_skip_defs(node):
+                if not isinstance(n, ast.Call):
+                    continue
+                parts = _dotted(n.func)
+                msg = None
+                if parts and parts[0] in ("jax", "jnp"):
+                    msg = f"`{'.'.join(parts)}(...)`"
+                elif isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "block_until_ready":
+                    msg = "`.block_until_ready()`"
+                elif isinstance(n.func, ast.Name) \
+                        and n.func.id in program_vars:
+                    msg = f"compiled-program call `{n.func.id}(...)`"
+                if msg:
+                    self._emit(
+                        "PT005", n,
+                        f"{msg} while holding `{lockish}` — device dispatch "
+                        "under a lock serializes every other thread for the "
+                        "full device latency; snapshot under the lock, "
+                        "dispatch outside")
+
+    def _check_pt006(self):
+        if self.counter_patterns is None:
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in ("inc", "set_gauge"):
+                continue
+            base = _dotted(node.func)
+            if len(base) < 2 or base[-2] not in ("counters", "_counters"):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            name, is_prefix = None, False
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                name = arg.value
+            elif isinstance(arg, ast.JoinedStr):
+                prefix = ""
+                for v in arg.values:
+                    if isinstance(v, ast.Constant) and isinstance(v.value,
+                                                                  str):
+                        prefix += v.value
+                    else:
+                        break
+                name, is_prefix = prefix, True
+            elif isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add) \
+                    and isinstance(arg.left, ast.Constant) \
+                    and isinstance(arg.left.value, str):
+                name, is_prefix = arg.left.value, True
+            if name is None or (is_prefix and not name):
+                continue
+            if not _counter_name_ok(name, is_prefix, self.counter_patterns):
+                kind = "prefix" if is_prefix else "name"
+                self._emit(
+                    "PT006", node,
+                    f"counter {kind} {name!r} is not in the documented "
+                    "table in profiler/counters.py — add a docstring row "
+                    "(and README) or fix the name")
+
+    # -- driver ------------------------------------------------------------
+    def run(self) -> list:
+        for region in self._traced_regions():
+            self._check_traced_body(region)
+        self._check_pt002()
+        self._check_pt003()
+        self._check_pt005()
+        self._check_pt006()
+        self.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        return self.findings
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def lint_source(src: str, path: str = "<string>",
+                counter_patterns=None, check_counters: bool = True) -> list:
+    """Lint one source blob; returns every finding (suppressed ones carry
+    ``suppressed=True``).  ``counter_patterns`` overrides the PT006 table
+    (pass ``check_counters=False`` to skip PT006 entirely)."""
+    if check_counters and counter_patterns is None:
+        counter_patterns = documented_counter_patterns()
+    if not check_counters:
+        counter_patterns = None
+    return _FileLint(src, path, counter_patterns).run()
+
+
+def lint_file(path: str, root: str | None = None) -> list:
+    rel = os.path.relpath(path, root) if root else path
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    # counter discipline only applies inside the package (tests/scripts
+    # legitimately mint scratch names)
+    check_ctrs = "paddle_tpu" in rel.replace(os.sep, "/")
+    try:
+        return lint_source(src, rel, check_counters=check_ctrs)
+    except SyntaxError as e:
+        return [LintFinding(rule="PT000", path=rel,
+                            line=e.lineno or 1, col=e.offset or 0,
+                            message=f"syntax error: {e.msg}")]
+
+
+def default_targets(root: str) -> list:
+    """The repo surface the CI sweep covers: the package + driver scripts."""
+    targets = []
+    pkg = os.path.join(root, "paddle_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                targets.append(os.path.join(dirpath, fn))
+    scripts = os.path.join(root, "scripts")
+    if os.path.isdir(scripts):
+        for fn in sorted(os.listdir(scripts)):
+            if fn.endswith(".py"):
+                targets.append(os.path.join(scripts, fn))
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        targets.append(bench)
+    return targets
+
+
+def lint_paths(paths, root: str | None = None) -> list:
+    findings: list = []
+    for p in paths:
+        findings.extend(lint_file(p, root=root))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline (grandfathered debt; CI gates zero NEW violations)
+# ---------------------------------------------------------------------------
+
+def fingerprint(finding: LintFinding) -> str:
+    """Stable id for baselining: rule + file + normalized source line (no
+    line numbers, so unrelated edits above don't churn the baseline)."""
+    basis = f"{finding.rule}:{finding.path}:{finding.snippet}"
+    return hashlib.sha1(basis.encode("utf-8")).hexdigest()[:16]
+
+
+def load_baseline(path: str) -> set:
+    if not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return set(data.get("fingerprints", []))
+
+
+def save_baseline(path: str, findings) -> None:
+    fps = sorted({fingerprint(f) for f in findings if not f.suppressed})
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"comment": "ptlint grandfathered findings; goal: empty",
+                   "fingerprints": fps}, f, indent=2)
+        f.write("\n")

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as _P
 
+from ..analysis import program_audit as _audit
 from ..core import flags as _flags
 from ..core.state import STATE, no_grad_guard
 from ..core.tensor import Parameter, Tensor
@@ -753,9 +754,12 @@ class CompiledTrainStep:
             return (loss, params, buffers, opt_state, sstate, rng_key,
                     checks, self._macc_add(macc, loss, mets), mets)
 
+        # NB: `donate + (7,) if donate else ()` would parse as
+        # `(donate + (7,)) if donate else ()` (PT003) — keep the ternary
+        # inside the sum so the macc arg's donation tracks the carry's
         donate = self._donate_argnums()
         return jax.jit(step_fn,
-                       donate_argnums=donate + (7,) if donate else ())
+                       donate_argnums=donate + ((7,) if donate else ()))
 
     def _make_fused_jit(self, check_nan_inf, k, metrics_on=False):
         """Fused window program: ``jax.lax.scan`` of the single-step body
@@ -814,7 +818,7 @@ class CompiledTrainStep:
 
         donate = self._donate_argnums()
         return jax.jit(window_fn,
-                       donate_argnums=donate + (7,) if donate else ())
+                       donate_argnums=donate + ((7,) if donate else ()))
 
     def __call__(self, *args):
         with _trace.span("jit.step"):
@@ -927,12 +931,18 @@ class CompiledTrainStep:
         if mon:
             self._ensure_macc()
         params, buffers, opt_state, sstate, rng_key = self._state
-        if fresh and _metrics.device_telemetry_enabled():
+        if fresh and (_metrics.device_telemetry_enabled()
+                      or _audit.audit_enabled()):
             cargs = (params, buffers, opt_state, self._lr_dev, rng_key,
                      sstate, args_data) + ((self._macc,) if mon else ())
-            _metrics.capture_program_stats(
-                f"jit.step[check={int(check)},metrics={int(mon)}]",
-                jit_fn, *cargs)
+            pname = f"jit.step[check={int(check)},metrics={int(mon)}]"
+            if _metrics.device_telemetry_enabled():
+                _metrics.capture_program_stats(pname, jit_fn, *cargs)
+            donate = self._donate_argnums()
+            _audit.maybe_audit(
+                pname, jit_fn, *cargs,
+                donate_argnums=donate + ((7,) if donate and mon else ()),
+                expect_no_collectives=self.mesh is None)
         traces_before = _counters.get("jit.traces")
         with _trace.span("jit.dispatch"):
             _counters.inc("jit.host.dispatches")
@@ -986,12 +996,18 @@ class CompiledTrainStep:
         if mon:
             self._ensure_macc()
         params, buffers, opt_state, sstate, rng_key = self._state
-        if fresh and _metrics.device_telemetry_enabled():
+        if fresh and (_metrics.device_telemetry_enabled()
+                      or _audit.audit_enabled()):
             cargs = (params, buffers, opt_state, self._lrs_dev, rng_key,
                      sstate, args_data) + ((self._macc,) if mon else ())
-            _metrics.capture_program_stats(
-                f"jit.window[check={int(check)},k={k},metrics={int(mon)}]",
-                jit_fn, *cargs)
+            pname = f"jit.window[check={int(check)},k={k},metrics={int(mon)}]"
+            if _metrics.device_telemetry_enabled():
+                _metrics.capture_program_stats(pname, jit_fn, *cargs)
+            donate = self._donate_argnums()
+            _audit.maybe_audit(
+                pname, jit_fn, *cargs,
+                donate_argnums=donate + ((7,) if donate and mon else ()),
+                expect_no_collectives=self.mesh is None)
         traces_before = _counters.get("jit.traces")
         with _trace.span("jit.dispatch"):
             _counters.inc("jit.host.dispatches")

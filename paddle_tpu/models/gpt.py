@@ -827,10 +827,16 @@ class GPTForCausalLM(Layer):
         def logits_of(h_last):
             return _lm_logits(c, wte, lnf_w, lnf_b, head, h_last)
 
+        # normalize the sampling knobs to host scalars once, outside the
+        # traced body — they are trace-time constants, not traced values
+        do_sample = bool(do_sample)
+        temperature = float(temperature)
+        top_k, top_p = int(top_k), float(top_p)
+
         def sample(lg, k):
-            return sample_tokens(lg, k, do_sample=bool(do_sample),
-                                 temperature=float(temperature),
-                                 top_k=int(top_k), top_p=float(top_p),
+            return sample_tokens(lg, k, do_sample=do_sample,
+                                 temperature=temperature,
+                                 top_k=top_k, top_p=top_p,
                                  out_dtype=ids.dtype)
 
         def run(lws, wte, wpe, lnf_w, lnf_b, head, ids, key):

@@ -16,6 +16,7 @@ Well-known names (see README "Observability" for the full table):
   jit.fused_windows / jit.fused_fallback_steps
   jit.host.layer_state / jit.host.bind_layer_state /
   jit.host.optimizer_state / jit.host.bind_optimizer_state
+  jit.nan_inf_checks / jit.nan_inf_hits (FLAGS_check_nan_inf sweeps)
   static.runs / static.compiles / static.traces
   io.device_put_calls / io.device_put_bytes
   io.stack_windows / io.stack_batches
@@ -37,8 +38,17 @@ Well-known names (see README "Observability" for the full table):
   serving.fleet.respawns / serving.fleet.replica_deaths[.<reason>]
   serving.fleet.heartbeat_misses (stall detector trips)
   serving.fleet.completed[.<reason>] / serving.fleet.replayed_tokens
+  serving.fleet.warmup_requests / serving.fleet.monitor_errors
+  serving.fleet.replay_divergence (resumed stream disagreed with replay)
+  serving.fleet.prefix_routed (dispatches won by prefix-cache affinity)
   serving.fleet.lost (admitted request without terminal state; MUST be 0)
   serving.fleet.replicas / serving.fleet.decode_tps (gauges)
+  serving.kv.prefix_hits / serving.kv.prefix_misses /
+  serving.kv.prefix_hit_tokens (paged radix prefix-cache outcomes)
+  serving.kv.cow_copies (copy-on-write partial-block adoptions)
+  serving.kv.blocks_evicted / serving.kv.pool_exhausted
+  serving.kv.prefill_chunks (chunked-prefill program launches)
+  serving.kv.blocks_used (gauge: block-pool blocks currently owned)
   resilience.saves / resilience.save_ms / resilience.restores
   resilience.resharded_restores (restores onto a different mesh shape)
   resilience.retries / resilience.corrupt_detected
@@ -60,6 +70,10 @@ Well-known names (see README "Observability" for the full table):
       deadline/error/retried)
   goodput.fraction / goodput.accounted / goodput.wall_ns /
   goodput.<bucket>_ns (gauges: GoodputLedger.report() wall-clock split)
+  analysis.audits (programs AOT-audited under FLAGS_program_audit)
+  analysis.findings / analysis.findings.<rule> (audit invariant
+      violations: donation-dropped / host-callback / dynamic-shape /
+      f64-promotion / collective-budget / hbm-budget / trace-error)
 
 Latency *distributions* (serving.ttft_ns, serving.itl_ns,
 serving.queue_wait_ns, io.prefetch_stall_ns, resilience.save_ms, ...)

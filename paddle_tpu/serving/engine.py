@@ -283,6 +283,17 @@ class LLMEngine:
             self._captured.add(name)
             metrics.capture_program_stats(name, fn, *args)
 
+    def _maybe_audit(self, name, fn, *args, donate_argnums=()):
+        """AOT-audit a compiled program once per name under
+        FLAGS_program_audit (donation aliasing, host callbacks, static
+        shapes, collective census — see analysis/program_audit).  Like
+        ``_maybe_capture``, the audit's extra AOT trace bumps
+        ``serving.retraces`` once per program, at the compile/warmup site
+        only — steady-state windows see a no-op set lookup."""
+        from ..analysis import program_audit as _audit
+        _audit.maybe_audit(name, fn, *args, donate_argnums=donate_argnums,
+                           expect_no_collectives=True)
+
     def histogram_snapshot(self):
         """Copies of the per-engine histograms (point-in-time, safe to
         ``Histogram.merge`` across replicas — the fleet Router does)."""
@@ -599,11 +610,16 @@ class LLMEngine:
                              np.int32(req.top_k), np.float32(req.top_p))
                     self._maybe_capture(f"serving.prefill[b{bucket}]",
                                         pf, *pargs)
+                    self._maybe_audit(f"serving.prefill[b{bucket}]",
+                                      pf, *pargs)
                     kc, vc, tok, new_key = pf(*pargs)
                     ins = self._insert_for(bucket)
                     self._maybe_capture(f"serving.insert[b{bucket}]", ins,
                                         self._ck, self._cv, kc, vc,
                                         np.int32(slot))
+                    self._maybe_audit(f"serving.insert[b{bucket}]", ins,
+                                      self._ck, self._cv, kc, vc,
+                                      np.int32(slot), donate_argnums=(0, 1))
                     self._ck, self._cv = ins(
                         self._ck, self._cv, kc, vc, np.int32(slot))
                 if tr is not None:
@@ -649,6 +665,8 @@ class LLMEngine:
                      jnp.asarray(self._temp), jnp.asarray(self._topk),
                      jnp.asarray(self._topp))
             self._maybe_capture("serving.decode", dec, *dargs)
+            self._maybe_audit("serving.decode", dec, *dargs,
+                              donate_argnums=(1, 2))
             nxt, self._ck, self._cv, new_keys = dec(*dargs)
             nxt = np.asarray(nxt)
         if tr_on:

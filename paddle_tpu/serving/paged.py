@@ -270,6 +270,12 @@ class PagedLLMEngine(LLMEngine):
                 cargs = (self._pk, self._pv, np.int32(pnode.block),
                          np.int32(table[len(shared)]), np.int32(p))
                 self._maybe_capture("serving.kv.copy_block", cp, *cargs)
+                self._maybe_audit("serving.kv.copy_block", cp, *cargs,
+                                  donate_argnums=(0, 1))
+                # the reservation (pool alloc + table + COW adopt) must be
+                # atomic w.r.t. concurrent cancel/router stats, so this one
+                # bounded block-copy dispatch stays under the lock
+                # ptlint: disable=PT005 reason="COW adopt is part of the atomic reservation; a bounded one-block copy, not a per-token dispatch"
                 self._pk, self._pv = cp(*cargs)
                 if tr is not None:
                     tr.add_span("cow.adopt", t0_cow,
@@ -356,6 +362,8 @@ class PagedLLMEngine(LLMEngine):
                      np.bool_(req.do_sample), np.float32(req.temperature),
                      np.int32(req.top_k), np.float32(req.top_p))
             self._maybe_capture(f"serving.prefill_paged[c{C}]", pf, *pargs)
+            self._maybe_audit(f"serving.prefill_paged[c{C}]", pf, *pargs,
+                              donate_argnums=(5, 6))
             self._pk, self._pv, tok, new_key = pf(*pargs)
         if tr is not None:
             tr.add_span("prefill.chunk", t0_tr, time.perf_counter_ns(),
@@ -423,6 +431,8 @@ class PagedLLMEngine(LLMEngine):
                      jnp.asarray(self._temp), jnp.asarray(self._topk),
                      jnp.asarray(self._topp))
             self._maybe_capture("serving.decode_paged", dec, *dargs)
+            self._maybe_audit("serving.decode_paged", dec, *dargs,
+                              donate_argnums=(1, 2))
             nxt, self._pk, self._pv, new_keys = dec(*dargs)
             nxt = np.asarray(nxt)
         if tr_on:

@@ -651,6 +651,131 @@ def run():
                                                             "[0.2, 1.3]")
     rtrace.clear()
 
+    # ---- program-audit gate: FLAGS_program_audit=enforce holds over the
+    # whole compiled-program surface (train single/fused/mesh-dp2 +
+    # slot/paged serving incl. the COW copy program) with zero findings,
+    # and audit ON adds ZERO syncs/traces/dispatches/retraces to any
+    # measured steady-state window — audits run once per program, at the
+    # compile/warmup sites.  Then each deliberately-broken fixture must be
+    # caught and named by rule.
+    from paddle_tpu import analysis as panalysis
+
+    def audit_workloads():
+        """Fresh train steps (metrics / fused / mesh-dp2) + slot/paged
+        engines over fixed workloads.  All compiles (and audits, when on)
+        happen before the snapshot; returns the measured parity delta."""
+        panalysis.reset_audited()
+        paddle.seed(0)
+        am = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+        aopt = paddle.optimizer.AdamW(1e-3, parameters=am.parameters())
+        astep = pjit.CompiledTrainStep(am, loss_fn, aopt, metrics=True)
+        for _ in range(WARMUP):
+            astep(x, y).numpy()
+        paddle.seed(0)
+        afm = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+        afopt = paddle.optimizer.AdamW(1e-3, parameters=afm.parameters())
+        afstep = pjit.CompiledTrainStep(afm, loss_fn, afopt,
+                                        fused_steps=FUSED_K)
+        afstep(window()).numpy()  # priming single-step fallback
+        afstep(window()).numpy()  # scan compile
+        amstep = None
+        if jax.device_count() >= 2:
+            from jax.sharding import Mesh as _Mesh
+            # the mesh step program shares its name with the single-device
+            # one — re-arm the once-per-name audit so it is audited too
+            panalysis.reset_audited()
+            amesh = _Mesh(np.array(jax.devices()[:2]).reshape(2), ("dp",))
+            paddle.seed(0)
+            amm = nn.Sequential(nn.Linear(16, 32), nn.GELU(),
+                                nn.Linear(32, 4))
+            amopt = paddle.optimizer.AdamW(1e-3,
+                                           parameters=amm.parameters())
+            amstep = pjit.CompiledTrainStep(amm, loss_fn, amopt, mesh=amesh)
+            for _ in range(WARMUP):
+                amstep(x, y).numpy()
+        e4 = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4)
+        p4 = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4,
+                       kv_layout="paged", block_size=4, prefill_chunk=8)
+        rng4 = np.random.RandomState(7)
+
+        def sv(e_, lens):
+            hs = [e_.add_request(rng4.randint(0, 64, size=n).tolist(),
+                                 max_new_tokens=3) for n in lens]
+            while not all(h.is_finished for h in hs):
+                e_.step()
+            return hs
+
+        sv(e4, SERVE_LENS_WARM)
+        ah0 = sv(p4, SERVE_LENS_WARM)[0]
+        # compile (and audit) the COW copy program at warmup: extend a
+        # cached sequence past its partial prefix block
+        acw = (list(ah0.prompt) + ah0.tokens)[:5] + [int(ah0.prompt[0])]
+        ahc = p4.add_request(acw, max_new_tokens=3)
+        while not ahc.is_finished:
+            p4.step()
+
+        b = counters.snapshot()
+        for _ in range(MEASURE):
+            astep(x, y).numpy()
+        for _ in range(FUSED_MEASURE):
+            afstep(window()).numpy()
+        if amstep is not None:
+            for _ in range(MEASURE):
+                amstep(x, y).numpy()
+        sv(e4, SERVE_LENS_MEASURE)
+        sv(p4, SERVE_LENS_MEASURE)
+        return _pick(counters.delta(b))
+
+    pflags.set_flags({"FLAGS_program_audit": "off"})
+    audit_off = audit_workloads()
+    pflags.set_flags({"FLAGS_program_audit": "enforce"})
+    abefore = counters.snapshot()
+    try:
+        # any finding raises ProgramAuditError straight out of run()
+        audit_on = audit_workloads()
+    finally:
+        pflags.set_flags({"FLAGS_program_audit": "off"})
+    audit_delta = counters.delta(abefore)
+    if audit_on != audit_off:
+        violations["audit-parity"] = (audit_on, audit_off)
+    audits_run = audit_delta.get("analysis.audits", 0)
+    if audits_run < 10:   # step x2 + window + mesh step + 5 slot + 3+ paged
+        violations["audit:coverage"] = (audits_run, ">=10")
+    if audit_delta.get("analysis.findings", 0):
+        violations["audit:findings"] = (
+            audit_delta.get("analysis.findings", 0), 0)
+
+    # seeded-broken fixtures: the auditor must catch each one by name
+    import jax.numpy as jnp
+
+    def cb_prog(v):
+        out = jax.pure_callback(
+            lambda a: np.asarray(a), jax.ShapeDtypeStruct(v.shape, v.dtype),
+            v)
+        return out + 1
+
+    def drop_prog(v):   # donated (4,4) input, only a scalar output
+        return jnp.sum(v)
+
+    fixture_got = {}
+    v4 = jnp.ones((4, 4), jnp.float32)
+    rep = panalysis.audit_program("fixture.callback", jax.jit(cb_prog), v4)
+    fixture_got["host-callback"] = sorted({f.rule for f in rep.findings})
+    rep = panalysis.audit_program(
+        "fixture.donation", jax.jit(drop_prog, donate_argnums=(0,)), v4,
+        donate_argnums=(0,))
+    fixture_got["donation-dropped"] = sorted({f.rule for f in rep.findings})
+    from jax import export as jexport
+    bdim = jexport.symbolic_shape("b, 4")
+    rep = panalysis.audit_program(
+        "fixture.dynamic", jax.jit(lambda z: z * 2),
+        jax.ShapeDtypeStruct(bdim, jnp.float32), compile_program=False)
+    fixture_got["dynamic-shape"] = sorted({f.rule for f in rep.findings})
+    for want_rule, got_rules in fixture_got.items():
+        if want_rule not in got_rules:
+            violations[f"audit-fixture:{want_rule}"] = (got_rules,
+                                                        want_rule)
+
     result = {"metric": "steady_state_counter_violations",
               "value": len(violations),
               "unit": f"violations/{MEASURE} steps "
@@ -680,7 +805,12 @@ def run():
               "trace_parity": {"off": _pick(toff), "on": _pick(ton),
                                "off_trace_moved": off_moved,
                                "on_finished": ton.get("trace.finished", 0)},
-              "trace_span_ratios": trace_ratios}
+              "trace_span_ratios": trace_ratios,
+              "program_audit": {"off": audit_off, "on": audit_on,
+                                "audits": audits_run,
+                                "findings": audit_delta.get(
+                                    "analysis.findings", 0),
+                                "fixtures": fixture_got}}
     print(json.dumps(result))
     if violations:
         raise AssertionError(

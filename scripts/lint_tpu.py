@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""TPU-hazard lint driver (rules PT001–PT006; see paddle_tpu/analysis/lint.py).
+
+Usage:
+  python scripts/lint_tpu.py                # report all findings
+  python scripts/lint_tpu.py --check        # CI gate: fail on NEW findings
+  python scripts/lint_tpu.py --update-baseline
+  python scripts/lint_tpu.py --json         # machine-readable output
+  python scripts/lint_tpu.py path.py ...    # lint specific files
+
+``--check`` compares active (non-suppressed) findings against
+``scripts/lint_baseline.json`` by stable fingerprint and exits nonzero if
+anything new appears (or if baselined entries are plain missing — stale
+baselines are debt too).  The goal state is an empty baseline: every
+intentional hazard carries an inline ``# ptlint: disable=PTNNN
+reason="..."`` instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from paddle_tpu.analysis import lint  # noqa: E402
+
+BASELINE = os.path.join(ROOT, "scripts", "lint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files to lint (default: paddle_tpu/ + scripts/)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on findings not in the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite scripts/lint_baseline.json from findings")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or lint.default_targets(ROOT)
+    findings = lint.lint_paths(paths, root=ROOT)
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.as_json:
+        print(json.dumps([{
+            "rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+            "message": f.message, "suppressed": f.suppressed,
+            "reason": f.reason, "fingerprint": lint.fingerprint(f),
+        } for f in findings], indent=2))
+    else:
+        shown = findings if args.show_suppressed else active
+        for f in shown:
+            print(f.format())
+        print(f"ptlint: {len(active)} active finding(s), "
+              f"{len(suppressed)} suppressed, {len(paths)} file(s)")
+
+    if args.update_baseline:
+        lint.save_baseline(BASELINE, findings)
+        print(f"ptlint: wrote baseline ({len(active)} entries) -> {BASELINE}")
+        return 0
+
+    if args.check:
+        baseline = lint.load_baseline(BASELINE)
+        new = [f for f in active if lint.fingerprint(f) not in baseline]
+        fixed = baseline - {lint.fingerprint(f) for f in active}
+        if new:
+            print(f"ptlint: {len(new)} NEW finding(s) not in baseline:")
+            for f in new:
+                print("  " + f.format())
+            return 1
+        if fixed:
+            print(f"ptlint: {len(fixed)} baseline entr(ies) no longer "
+                  "fire — run --update-baseline to shed the debt")
+            return 1
+        print("ptlint: check OK (no new findings)")
+        return 0
+
+    return 1 if active and not args.paths else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
