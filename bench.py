@@ -559,6 +559,154 @@ def _run_paged_leg(cfg, n_requests=64, max_new=64, max_slots=8,
     return leg
 
 
+def _run_paged_q_leg(cfg, n_requests=64, max_new=64, max_slots=4,
+                     min_bucket=8, block_size=16, prefill_chunk=256,
+                     kv_dtype="int8", n_verify=4, seed=0):
+    """Quantized-KV capacity leg: an ``kv_dtype`` paged engine vs the
+    model-dtype paged baseline at the SAME KV HBM byte budget.
+
+    The baseline pool is sized like the paged leg's
+    (``max_slots * ceil(S/bs)`` blocks of the model dtype); the
+    quantized pool gets ``floor(budget / quant_block_bytes)`` blocks
+    where a quantized block costs 1 byte/value plus the per-token fp32
+    scale rows (8 bytes per token across K and V).  Both engines serve
+    the same memory-bound workload (identical-length prompts, scheduling
+    slots ample, so admission is bounded by pool bytes alone) — gated at
+    >= 2x peak concurrent admitted requests with zero steady retraces.
+    Decode tok/s and TTFT/ITL are reported for both; the >=0.9x decode
+    parity gate applies on TPU only (on CPU the dequant is extra VPU-less
+    arithmetic, numbers informational).  Token identity of the quantized
+    engine is gated in tests/ and scripts/check_counters.py on the tiny
+    model; here the baseline engine is verified against ``generate`` and
+    the quantized match count is reported."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.kernels.paged_attention import KV_DTYPES
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.profiler import counters
+    from paddle_tpu.serving import LLMEngine
+    from paddle_tpu.serving.kvcache import blocks_for_tokens
+
+    paddle.seed(seed)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(seed)
+    S = cfg.max_seq_len
+    L, nh = cfg.num_layers, cfg.num_heads
+    hd = cfg.hidden_size // nh
+    bs = block_size
+    dt = jnp.dtype(cfg.dtype)
+    adt = jnp.dtype(KV_DTYPES[kv_dtype])
+    # the fixed byte budget: the baseline pool's K+V arena
+    raw_block = 2 * L * bs * nh * hd * dt.itemsize
+    q_block = 2 * L * bs * nh * hd * adt.itemsize + 2 * L * bs * 4
+    n_blocks_raw = max_slots * blocks_for_tokens(S, bs) + 1
+    budget = n_blocks_raw * raw_block
+    n_blocks_q = int(budget // q_block)
+
+    plen = max(2, S // 8)
+    prompts = [rng.randint(0, cfg.vocab_size, size=plen).tolist()
+               for _ in range(n_requests)]
+    n_verify = min(n_verify, n_requests)
+    refs = [np.asarray(model.generate(
+        paddle.to_tensor(np.asarray([p])),
+        max_new_tokens=max_new).numpy())[0] for p in prompts[:n_verify]]
+
+    def engine(n_blocks, **kw):
+        eng = LLMEngine(model, max_slots=n_requests, max_seq_len=S,
+                        min_bucket=min_bucket, kv_layout="paged",
+                        block_size=bs, n_blocks=n_blocks,
+                        prefill_chunk=prefill_chunk, prefix_cache=False,
+                        **kw)
+        b, pwarm = min_bucket, []
+        while b <= eng.prefill_chunk:
+            pwarm.append(rng.randint(0, cfg.vocab_size,
+                                     size=min(b, S - 3)).tolist())
+            b *= 2
+        for _ in eng.generate(pwarm, max_new_tokens=2):
+            pass
+        return eng
+
+    def serve(eng):
+        hs = [eng.add_request(p, max_new_tokens=max_new) for p in prompts]
+        peak = 0
+        t0 = time.perf_counter()
+        while not all(h.is_finished for h in hs):
+            eng.step()
+            peak = max(peak, eng.stats()["active"])
+        return hs, peak, time.perf_counter() - t0
+
+    beng = engine(n_blocks_raw)
+    bhs, raw_peak, raw_s = serve(beng)
+    raw_tps = n_requests * max_new / max(raw_s, 1e-9)
+    for h, r in zip(bhs[:n_verify], refs):
+        if not np.array_equal(h.output_ids(), r):
+            raise AssertionError(
+                "paged_q leg: baseline paged output diverged from "
+                "generate")
+    raw_snap = beng.histogram_snapshot()
+    del beng
+
+    qeng = engine(n_blocks_q, kv_dtype=kv_dtype)
+    qbefore = counters.snapshot()
+    qhs, q_peak, q_s = serve(qeng)
+    qdelta = counters.delta(qbefore)
+    q_tps = n_requests * max_new / max(q_s, 1e-9)
+    q_match = sum(int(np.array_equal(h.output_ids(), r))
+                  for h, r in zip(qhs[:n_verify], refs))
+    capacity_ratio = q_peak / max(1, raw_peak)
+    if capacity_ratio < 2.0:
+        raise AssertionError(
+            f"paged_q leg: {kv_dtype} peak concurrency {q_peak} vs "
+            f"{dt.name} {raw_peak} = {capacity_ratio:.2f}x at the same "
+            "KV HBM byte budget (want >= 2x)")
+    if qdelta.get("serving.retraces", 0):
+        raise AssertionError(
+            f"paged_q leg: {qdelta['serving.retraces']} steady retraces "
+            "on the quantized engine (want 0)")
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    decode_parity = q_tps / max(raw_tps, 1e-9)
+    if on_tpu and decode_parity < 0.9:
+        raise AssertionError(
+            f"paged_q leg: quantized decode {q_tps:.1f} tok/s vs "
+            f"baseline {raw_tps:.1f} = {decode_parity:.2f}x (want >= "
+            "0.9x on TPU)")
+    q_snap = qeng.histogram_snapshot()
+    leg = {"kv_dtype": kv_dtype,
+           "requests": n_requests,
+           "max_new_tokens": max_new,
+           "prompt_tokens": plen,
+           "block_size": bs,
+           "kv_hbm_budget_bytes": int(budget),
+           "n_blocks_raw": n_blocks_raw,
+           "n_blocks_quant": n_blocks_q,
+           "block_bytes_raw": raw_block,
+           "block_bytes_quant": q_block,
+           "arena_bytes_quant": counters.get(
+               "serving.kv.quant.arena_bytes"),
+           "bytes_saved_vs_same_blocks": counters.get(
+               "serving.kv.quant.bytes_saved"),
+           "peak_concurrent_raw": raw_peak,
+           "peak_concurrent_quant": q_peak,
+           "capacity_ratio": round(capacity_ratio, 3),
+           "decode_tokens_per_sec_raw": round(raw_tps, 2),
+           "decode_tokens_per_sec_quant": round(q_tps, 2),
+           "decode_parity": round(decode_parity, 4),
+           "steady_retraces": qdelta.get("serving.retraces", 0),
+           "quant_tokens": qdelta.get("serving.kv.quant.prefill_tokens",
+                                      0)
+           + qdelta.get("serving.kv.quant.decode_tokens", 0),
+           "verified_match_raw": n_verify,
+           "verified_match_quant": f"{q_match}/{n_verify}",
+           "ttft_raw": _latency_ms(raw_snap["serving.ttft_ns"]),
+           "ttft_quant": _latency_ms(q_snap["serving.ttft_ns"]),
+           "itl_raw": _latency_ms(raw_snap["serving.itl_ns"]),
+           "itl_quant": _latency_ms(q_snap["serving.itl_ns"])}
+    del qeng, model
+    return leg
+
+
 def _run_fleet_leg(cfg, replicas=2, n_requests=8, max_new=32, max_slots=4,
                    min_bucket=8, seed=0):
     """Elastic-fleet leg: the same seeded request set through a
@@ -890,6 +1038,13 @@ def main():
                                       max_slots=4, min_bucket=4,
                                       block_size=4, prefill_chunk=16,
                                       n_verify=4)
+        # tiny quantized-KV leg: >=2x admitted capacity at the same KV
+        # byte budget (fp32 arena -> ~4x blocks on CPU); throughput
+        # informational
+        out["paged_q"] = _run_paged_q_leg(cfg, n_requests=48, max_new=8,
+                                          max_slots=2, min_bucket=4,
+                                          block_size=4, prefill_chunk=16,
+                                          n_verify=4)
         # tiny fleet leg: durability gates (zero lost, respawn == kills,
         # churn output identical) always; throughput informational on CPU
         out["fleet"] = _run_fleet_leg(cfg, replicas=2, n_requests=4,
@@ -907,11 +1062,11 @@ def main():
         return
 
     which = os.environ.get("PTPU_BENCH", "all")
-    if which not in ("all", "760m", "125m", "serve", "paged", "ckpt",
-                     "fleet", "mesh", "mesh760m"):
+    if which not in ("all", "760m", "125m", "serve", "paged", "paged_q",
+                     "ckpt", "fleet", "mesh", "mesh760m"):
         raise SystemExit(
             f"PTPU_BENCH={which!r}: expected "
-            f"all|760m|125m|serve|paged|ckpt|fleet|mesh|mesh760m")
+            f"all|760m|125m|serve|paged|paged_q|ckpt|fleet|mesh|mesh760m")
     mesh_degrees = _parse_mesh_degrees(os.environ.get("PTPU_MESH", "dp2"))
     mesh_ndev = int(np.prod(list(mesh_degrees.values())))
     legs = {}
@@ -989,6 +1144,17 @@ def main():
                                                max_new=64, max_slots=8,
                                                block_size=16,
                                                prefill_chunk=256)
+    if which in ("all", "paged_q"):
+        # quantized-KV leg: int8 arena vs bf16 paged at the same KV HBM
+        # byte budget — >=2x admitted concurrency, decode tok/s no worse
+        qcfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
+                                   dtype="bfloat16",
+                                   use_flash_attention=False,
+                                   recompute=None)
+        legs["gpt125m_paged_q"] = _run_paged_q_leg(qcfg, n_requests=64,
+                                                   max_new=64, max_slots=4,
+                                                   block_size=16,
+                                                   prefill_chunk=256)
     if which in ("all", "fleet"):
         # elastic-fleet leg: multi-replica throughput with and without
         # one replica killed mid-decode (acceptance: zero lost requests,
@@ -1044,6 +1210,16 @@ def main():
             "value": leg["decode_tokens_per_sec"],
             "unit": "tokens/s",
             "vs_baseline": leg["churn_retention"],  # vs one replica killed
+            "legs": legs,
+        }))
+        return
+    if set(legs) == {"gpt125m_paged_q"}:  # paged_q-only: quant capacity
+        leg = legs["gpt125m_paged_q"]
+        print(json.dumps({
+            "metric": "gpt125m_paged_q_admitted_capacity_ratio",
+            "value": leg["capacity_ratio"],
+            "unit": "x admitted vs bf16 paged at fixed KV HBM",
+            "vs_baseline": leg["decode_parity"],  # quant vs raw tok/s
             "legs": legs,
         }))
         return

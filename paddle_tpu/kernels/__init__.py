@@ -4,4 +4,5 @@ CUDA kernels + the Kernel Primitive abstraction phi/kernels/primitive/).
 Each kernel ships a Pallas implementation for TPU plus a jnp reference used
 off-TPU and in interpret-mode tests."""
 
-from . import flash_attention, rms_norm, rope  # noqa: F401
+from . import _shapes, flash_attention, paged_attention, rms_norm, rope  # noqa: F401
+from ._shapes import NEG_INF, neg_inf  # noqa: F401

@@ -22,6 +22,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from ._shapes import NEG_INF, check_divides
 
 _INTERPRET = [False]  # tests flip this on CPU
 
@@ -39,7 +40,7 @@ def reference_attention(q, k, v, causal=False, scale=None):
     if causal:
         s, t = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((s, t), dtype=bool), t - s)
-        logits = jnp.where(mask, logits, -1e30)
+        logits = jnp.where(mask, logits, NEG_INF)
     p = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhst,bthd->bshd", p.astype(v.dtype), v)
 
@@ -138,7 +139,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                     jnp.int32, (block_q, block_k), 0)
                 k_pos = start_k * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, -1e30)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
             m_cur = jnp.max(s, axis=-1)
             m_new = jnp.maximum(m_prev, m_cur)
             alpha = jnp.exp(m_prev - m_new)
@@ -148,7 +149,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
             return acc, m_new, l_new
 
         acc0 = jnp.zeros((block_q, d), jnp.float32)
-        m0 = jnp.full((block_q,), -1e30, jnp.float32)
+        m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
         l0 = jnp.zeros((block_q,), jnp.float32)
         acc, m, l = jax.lax.fori_loop(0, num_k_run, body, (acc0, m0, l0))
         o_ref[0, hh] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(
@@ -161,9 +162,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 def _flash_fwd(q, k, v, causal, scale):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    _params = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
     b, h, s, d = q.shape
     bh, block_q, block_k = _pick_blocks(h, s, d, q.dtype.itemsize)
+    check_divides("flash_attention_fwd", heads=(h, bh),
+                  seq_len_q=(s, block_q), seq_len_k=(s, block_k))
     grid = (b, h // bh, s // block_q)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_k=block_k, seq_len=s, bh=bh)
@@ -186,7 +190,7 @@ def _flash_fwd(q, k, v, causal, scale):
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_params(
             dimension_semantics=("parallel", "parallel", "parallel"),
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_INTERPRET[0],
@@ -225,7 +229,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                     jnp.int32, (block_q, block_k), 0)
                 k_pos = start_k * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, -1e30)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
             p = jnp.exp(s - lse[:, None])
             dp = _dot_f32(do, v, tb=True)
             ds = p * (dp - delta[:, None])
@@ -263,7 +267,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                     jnp.int32, (block_q, block_k), 0)
                 k_pos = ki * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, -1e30)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
             p = jnp.exp(s - lse[:, None])
             dv = dv + _dot_f32(p, do, ta=True)
             dp = _dot_f32(do, v, tb=True)
@@ -281,9 +285,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 def _flash_bwd(q, k, v, out, lse, do, causal, scale, dlse=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    _params = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
     b, h, s, d = q.shape
     bh, block_q, block_k = _pick_blocks(h, s, d, q.dtype.itemsize)
+    check_divides("flash_attention_bwd", heads=(h, bh),
+                  seq_len_q=(s, block_q), seq_len_k=(s, block_k))
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [b, h, s, 1] — lane-aligned like lse
     if dlse is not None:
@@ -311,7 +318,7 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, dlse=None):
         out_specs=pl.BlockSpec((1, bh, block_q, d),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_params(
             dimension_semantics=("parallel", "parallel", "parallel"),
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_INTERPRET[0],
@@ -341,7 +348,7 @@ def _flash_bwd(q, k, v, out, lse, do, causal, scale, dlse=None):
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_params(
             dimension_semantics=("parallel", "parallel", "parallel"),
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_INTERPRET[0],

@@ -16,7 +16,7 @@ hierarchy (VMEM tiles -> per-device sequence chunks).
 Causality by global chunk position: a visiting chunk strictly older than
 the local Q chunk attends in full (non-causal kernel), the diagonal chunk
 attends causally, newer chunks are skipped via a lax.switch branch that
-returns lse = -1e30 (zero weight in the merge, and XLA executes only the
+returns lse = NEG_INF (zero weight in the merge, and XLA executes only the
 taken branch, so skipped pairs cost nothing — the causal ring saves ~half
 the FLOPs).
 
@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .flash_attention import _INTERPRET, _on_tpu, reference_attention
+from ._shapes import NEG_INF, check_divides, check_equal
 
 
 def _chunk_attention(q, k, v, causal, scale):
@@ -52,7 +53,7 @@ def _chunk_attention(q, k, v, causal, scale):
     if causal:
         s, t = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((s, t), dtype=bool), t - s)
-        logits = jnp.where(mask, logits, -1e30)
+        logits = jnp.where(mask, logits, NEG_INF)
     m = jnp.max(logits, -1)
     p = jnp.exp(logits - m[..., None])
     l = jnp.sum(p, -1)
@@ -76,7 +77,7 @@ def _ring_body(q, k, v, axis, axis_size, causal, scale):
 
     def skip_fn(kv):
         return (jnp.zeros_like(q),
-                jnp.full((B, Sloc, H), -1e30, jnp.float32))
+                jnp.full((B, Sloc, H), NEG_INF, jnp.float32))
 
     def step(carry, s):
         kc, vc, acc, m_run, l_run = carry
@@ -99,7 +100,7 @@ def _ring_body(q, k, v, axis, axis_size, causal, scale):
         return (kc, vc, acc, m_new, l_new), None
 
     acc0 = jnp.zeros(q.shape, jnp.float32)
-    m0 = jnp.full((B, Sloc, H), -1e30, jnp.float32)
+    m0 = jnp.full((B, Sloc, H), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, Sloc, H), jnp.float32)
     (_, _, acc, m_run, l_run), _ = jax.lax.scan(
         step, (k, v, acc0, m0, l0), jnp.arange(axis_size))
@@ -120,6 +121,10 @@ def ring_attention(q, k, v, causal=True, scale=None, axis="sep", mesh=None):
         from .flash_attention import flash_attention_fwd
         return flash_attention_fwd(q, k, v, causal=causal, scale=scale)
     n = mesh.shape[axis]
+    check_equal("ring_attention",
+                k_seq_len=(k.shape[1], q.shape[1]),
+                v_seq_len=(v.shape[1], q.shape[1]))
+    check_divides("ring_attention", seq_len=(q.shape[1], n))
     spec = P(None, axis, None, None)
 
     def body(ql, kl, vl):

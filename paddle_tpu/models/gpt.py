@@ -29,6 +29,8 @@ from ..core.dispatch import apply_op, matmul_precision
 from ..core.tensor import Parameter, Tensor
 from ..distributed.env import get_mesh, hybrid_degrees
 from ..distributed.sharding_utils import annotate_param
+from ..kernels import paged_attention as _pa
+from ..kernels._shapes import NEG_INF
 from ..kernels.flash_attention import flash_attention_fwd, reference_attention
 from ..kernels.rope import rope_tables
 from ..nn.layer.layers import Layer
@@ -148,6 +150,22 @@ def _rope_rows(x, pos, base=10000.0):
     x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
     return out.astype(x.dtype)
+
+
+def _mm(x, lw, name):
+    """Layer matmul against a decode-state weight that may be int8
+    weight-only quantized (``quantization.ptq_int8_decode_state`` stores
+    ``name`` as int8 plus ``name + "__scale"`` fp32 per-output-channel).
+    Per-output-channel scales commute with the contraction, so dequant is
+    one row-vector multiply AFTER the matmul — the int8 weight is cast
+    (exact: |q| <= 127 fits every float dtype) as it is loaded, never
+    rematerialized in full precision in HBM."""
+    w = lw[name]
+    s = lw.get(name + "__scale")
+    if s is None:
+        return jnp.matmul(x, w, precision=matmul_precision())
+    y = jnp.matmul(x, w.astype(x.dtype), precision=matmul_precision())
+    return (y * s).astype(x.dtype)
 
 
 class GPTForCausalLM(Layer):
@@ -457,8 +475,7 @@ class GPTForCausalLM(Layer):
         def body(hh, xs):
             lw, ck, cv = xs
             x = _norm(hh, lw["ln1_w"], lw["ln1_b"], eps)
-            qkv = jnp.matmul(x, lw["qkv_w"], precision=matmul_precision()) \
-                + lw["qkv_b"]
+            qkv = _mm(x, lw, "qkv_w") + lw["qkv_b"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, T, nh, hd)
             k = k.reshape(B, T, nh, hd)
@@ -474,12 +491,11 @@ class GPTForCausalLM(Layer):
             logits = jnp.einsum("bqhd,bkhd->bhqk",
                                 (q * scale).astype(jnp.float32),
                                 ck.astype(jnp.float32))
-            logits = jnp.where(mask[None, None], logits, -1e30)
+            logits = jnp.where(mask[None, None], logits, NEG_INF)
             p = jax.nn.softmax(logits, axis=-1)
             o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cv.dtype), cv)
             o = o.reshape(B, T, H)
-            a = jnp.matmul(o, lw["proj_w"], precision=matmul_precision()) \
-                + lw["proj_b"]
+            a = _mm(o, lw, "proj_w") + lw["proj_b"]
             hh = hh + a
             x = _norm(hh, lw["ln2_w"], lw["ln2_b"], eps)
             if c.num_experts > 0:
@@ -489,10 +505,8 @@ class GPTForCausalLM(Layer):
                     lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
                     capacity_factor=c.moe_capacity_factor)
             else:
-                up = jnp.matmul(x, lw["fc1_w"],
-                                precision=matmul_precision()) + lw["fc1_b"]
-                f = jnp.matmul(jax.nn.gelu(up), lw["fc2_w"],
-                               precision=matmul_precision()) + lw["fc2_b"]
+                up = _mm(x, lw, "fc1_w") + lw["fc1_b"]
+                f = _mm(jax.nn.gelu(up), lw, "fc2_w") + lw["fc2_b"]
             return hh + f, (ck, cv)
 
         h, (cache_k, cache_v) = jax.lax.scan(body, h,
@@ -579,8 +593,7 @@ class GPTForCausalLM(Layer):
         def body(hh, xs):
             lw, ck, cv = xs
             x = _norm(hh, lw["ln1_w"], lw["ln1_b"], eps)
-            qkv = jnp.matmul(x, lw["qkv_w"], precision=matmul_precision()) \
-                + lw["qkv_b"]
+            qkv = _mm(x, lw, "qkv_w") + lw["qkv_b"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, 1, nh, hd)
             k = k.reshape(B, 1, nh, hd)
@@ -593,12 +606,11 @@ class GPTForCausalLM(Layer):
             logits = jnp.einsum("bqhd,bkhd->bhqk",
                                 (q * scale).astype(jnp.float32),
                                 ck.astype(jnp.float32))
-            logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+            logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
             p = jax.nn.softmax(logits, axis=-1)
             o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cv.dtype), cv)
             o = o.reshape(B, 1, H)
-            a = jnp.matmul(o, lw["proj_w"], precision=matmul_precision()) \
-                + lw["proj_b"]
+            a = _mm(o, lw, "proj_w") + lw["proj_b"]
             hh = hh + a
             x = _norm(hh, lw["ln2_w"], lw["ln2_b"], eps)
             if c.num_experts > 0:
@@ -608,10 +620,8 @@ class GPTForCausalLM(Layer):
                     lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
                     capacity_factor=c.moe_capacity_factor)
             else:
-                up = jnp.matmul(x, lw["fc1_w"],
-                                precision=matmul_precision()) + lw["fc1_b"]
-                f = jnp.matmul(jax.nn.gelu(up), lw["fc2_w"],
-                               precision=matmul_precision()) + lw["fc2_b"]
+                up = _mm(x, lw, "fc1_w") + lw["fc1_b"]
+                f = _mm(jax.nn.gelu(up), lw, "fc2_w") + lw["fc2_b"]
             return hh + f, (ck, cv)
 
         h, (cache_k, cache_v) = jax.lax.scan(
@@ -620,7 +630,8 @@ class GPTForCausalLM(Layer):
                             h[:, 0])
         return logits, cache_k, cache_v
 
-    def prefill_paged(self, w, ids, start, length, bt, pool_k, pool_v):
+    def prefill_paged(self, w, ids, start, length, bt, pool_k, pool_v,
+                      scale_k=None, scale_v=None):
         """One chunked-prefill step over a block-pool KV arena (the paged
         twin of ``prefill_slot``; see ``serving.paged``).
 
@@ -638,7 +649,14 @@ class GPTForCausalLM(Layer):
         (earlier chunks, shared prefix blocks) and the chunk itself.
         Returns ``(pool_k, pool_v, logits[1, V])`` with the fp32 logits
         read at the chunk's last valid token — the first-token sample
-        point when this is the final chunk."""
+        point when this is the final chunk.
+
+        Quantized-KV mode: when the engine passes per-token fp32 scale
+        arenas ``scale_k``/``scale_v [L, n_blocks, bs]`` (pool dtype
+        int8/fp8), each token's K/V is quantized on insert
+        (``kernels.paged_attention.quantize_kv``) and the gathered view
+        is dequantized for the chunk attention; the return grows to
+        ``(pool_k, pool_v, scale_k, scale_v, logits)``."""
         c = self.config
         nh = c.num_heads
         eps = c.layer_norm_epsilon
@@ -658,12 +676,17 @@ class GPTForCausalLM(Layer):
         kpos = jnp.arange(S)
         qpos = start + jnp.arange(C)
         mask = kpos[None, :] <= qpos[:, None]              # [C, S]
+        quant = scale_k is not None
+        kv_dt = _pa.kv_dtype_of(pool_k.dtype) if quant else None
 
         def body(hh, xs):
-            lw, ck, cv = xs
+            if quant:
+                lw, ck, cv, sk, sv = xs
+            else:
+                lw, ck, cv = xs
+                sk = sv = None
             x = _norm(hh, lw["ln1_w"], lw["ln1_b"], eps)
-            qkv = jnp.matmul(x, lw["qkv_w"], precision=matmul_precision()) \
-                + lw["qkv_b"]
+            qkv = _mm(x, lw, "qkv_w") + lw["qkv_b"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, C, nh, hd)
             k = k.reshape(B, C, nh, hd)
@@ -673,23 +696,39 @@ class GPTForCausalLM(Layer):
                 q = apply_rope(q, offset=start)
                 k = apply_rope(k, offset=start)
             vm = valid[:, None, None]
-            kz = jnp.where(vm, k[0].astype(ck.dtype), 0)
-            vz = jnp.where(vm, v[0].astype(cv.dtype), 0)
+            if quant:
+                # quantize on insert: tiles in the arena dtype, one fp32
+                # scale per token riding the scale arena at the same
+                # (block, offset) address
+                kq, ks = _pa.quantize_kv(k[0], kv_dt)
+                vq, vs = _pa.quantize_kv(v[0], kv_dt)
+                kz = jnp.where(vm, kq, jnp.zeros((), ck.dtype))
+                vz = jnp.where(vm, vq, jnp.zeros((), cv.dtype))
+                sk = sk.at[blk, off].set(jnp.where(valid, ks, 0.0))
+                sv = sv.at[blk, off].set(jnp.where(valid, vs, 0.0))
+            else:
+                kz = jnp.where(vm, k[0].astype(ck.dtype), 0)
+                vz = jnp.where(vm, v[0].astype(cv.dtype), 0)
             ck = ck.at[blk, off].set(kz)
             cv = cv.at[blk, off].set(vz)
             # gather AFTER the scatter: the logical view holds the shared
             # prefix, earlier chunks, and this chunk's own K/V
-            gk = ck[bt].reshape(S, nh, hd)[None]
-            gv = cv[bt].reshape(S, nh, hd)[None]
+            if quant:
+                gk = _pa.dequantize_kv(ck[bt], sk[bt]).reshape(
+                    S, nh, hd)[None]
+                gv = _pa.dequantize_kv(cv[bt], sv[bt]).reshape(
+                    S, nh, hd)[None]
+            else:
+                gk = ck[bt].reshape(S, nh, hd)[None]
+                gv = cv[bt].reshape(S, nh, hd)[None]
             logits = jnp.einsum("bqhd,bkhd->bhqk",
                                 (q * scale).astype(jnp.float32),
                                 gk.astype(jnp.float32))
-            logits = jnp.where(mask[None, None], logits, -1e30)
+            logits = jnp.where(mask[None, None], logits, NEG_INF)
             p = jax.nn.softmax(logits, axis=-1)
             o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(gv.dtype), gv)
-            o = o.reshape(B, C, H)
-            a = jnp.matmul(o, lw["proj_w"], precision=matmul_precision()) \
-                + lw["proj_b"]
+            o = o.reshape(B, C, H).astype(hh.dtype)
+            a = _mm(o, lw, "proj_w") + lw["proj_b"]
             hh = hh + a
             x = _norm(hh, lw["ln2_w"], lw["ln2_b"], eps)
             if c.num_experts > 0:
@@ -699,20 +738,25 @@ class GPTForCausalLM(Layer):
                     lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
                     capacity_factor=c.moe_capacity_factor)
             else:
-                up = jnp.matmul(x, lw["fc1_w"],
-                                precision=matmul_precision()) + lw["fc1_b"]
-                f = jnp.matmul(jax.nn.gelu(up), lw["fc2_w"],
-                               precision=matmul_precision()) + lw["fc2_b"]
-            return hh + f, (ck, cv)
+                up = _mm(x, lw, "fc1_w") + lw["fc1_b"]
+                f = _mm(jax.nn.gelu(up), lw, "fc2_w") + lw["fc2_b"]
+            return hh + f, ((ck, cv, sk, sv) if quant else (ck, cv))
 
-        h, (pool_k, pool_v) = jax.lax.scan(body, h,
-                                           (w["lws"], pool_k, pool_v))
+        if quant:
+            h, (pool_k, pool_v, scale_k, scale_v) = jax.lax.scan(
+                body, h, (w["lws"], pool_k, pool_v, scale_k, scale_v))
+        else:
+            h, (pool_k, pool_v) = jax.lax.scan(body, h,
+                                               (w["lws"], pool_k, pool_v))
         h_last = jax.lax.dynamic_slice_in_dim(h, length - 1, 1, axis=1)
         logits = _lm_logits(c, w["wte"], w["lnf_w"], w["lnf_b"], w["head"],
                             h_last[:, 0])
+        if quant:
+            return pool_k, pool_v, scale_k, scale_v, logits
         return pool_k, pool_v, logits
 
-    def decode_paged(self, w, tok, pos, bt, pool_k, pool_v):
+    def decode_paged(self, w, tok, pos, bt, pool_k, pool_v,
+                     scale_k=None, scale_v=None, kernel=None):
         """One decode step for B slot rows over the block-pool arena (the
         paged twin of ``decode_slots`` — identical math, the arena row is
         replaced by a block-table gather).
@@ -724,7 +768,17 @@ class GPTForCausalLM(Layer):
         ``bt[row, pos // bs]`` at offset ``pos % bs`` (rows with nothing
         to write are tabled to the trash block 0 by the engine) and
         attends over its gathered logical sequence with ``kpos <=
-        pos[row]``.  Returns ``(logits [B, V] fp32, pool_k, pool_v)``."""
+        pos[row]``.  Returns ``(logits [B, V] fp32, pool_k, pool_v)``.
+
+        ``kernel="pallas"`` routes the attention through the fused Pallas
+        block-table walk (``kernels.paged_attention``) instead of the
+        gather einsum — same operands, same mask, no ``[B, S]`` logical
+        view in HBM.  ``kernel=None``/``"off"`` keeps the plain-XLA
+        gather below as the reference twin.  Quantized-KV mode mirrors
+        ``prefill_paged``: per-token fp32 scale arenas ``scale_k``/
+        ``scale_v [L, n_blocks, bs]`` ride the donated carry, the new
+        token quantizes on insert, and the return grows to ``(logits,
+        pool_k, pool_v, scale_k, scale_v)``."""
         c = self.config
         nh = c.num_heads
         eps = c.layer_norm_epsilon
@@ -743,12 +797,21 @@ class GPTForCausalLM(Layer):
         rows = jnp.arange(B)
         blk = bt[rows, pos // bs]                                # [B]
         off = pos % bs
+        quant = scale_k is not None
+        kv_dt = _pa.kv_dtype_of(pool_k.dtype) if quant else None
+        mode = kernel or "off"
+        if mode not in ("off", "pallas"):
+            raise ValueError(f"decode_paged: kernel={mode!r}")
+        _pa.note_program(mode)
 
         def body(hh, xs):
-            lw, ck, cv = xs
+            if quant:
+                lw, ck, cv, sk, sv = xs
+            else:
+                lw, ck, cv = xs
+                sk = sv = None
             x = _norm(hh, lw["ln1_w"], lw["ln1_b"], eps)
-            qkv = jnp.matmul(x, lw["qkv_w"], precision=matmul_precision()) \
-                + lw["qkv_b"]
+            qkv = _mm(x, lw, "qkv_w") + lw["qkv_b"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, 1, nh, hd)
             k = k.reshape(B, 1, nh, hd)
@@ -756,19 +819,40 @@ class GPTForCausalLM(Layer):
             if c.use_rope:
                 q = _rope_rows(q, pos)
                 k = _rope_rows(k, pos)
-            ck = ck.at[blk, off].set(k[:, 0].astype(ck.dtype))
-            cv = cv.at[blk, off].set(v[:, 0].astype(cv.dtype))
-            gk = ck[bt].reshape(B, S, nh, hd)
-            gv = cv[bt].reshape(B, S, nh, hd)
-            logits = jnp.einsum("bqhd,bkhd->bhqk",
-                                (q * scale).astype(jnp.float32),
-                                gk.astype(jnp.float32))
-            logits = jnp.where(mask[:, None, None, :], logits, -1e30)
-            p = jax.nn.softmax(logits, axis=-1)
-            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(gv.dtype), gv)
-            o = o.reshape(B, 1, H)
-            a = jnp.matmul(o, lw["proj_w"], precision=matmul_precision()) \
-                + lw["proj_b"]
+            if quant:
+                kq, ks = _pa.quantize_kv(k[:, 0], kv_dt)
+                vq, vs = _pa.quantize_kv(v[:, 0], kv_dt)
+                ck = ck.at[blk, off].set(kq)
+                cv = cv.at[blk, off].set(vq)
+                sk = sk.at[blk, off].set(ks)
+                sv = sv.at[blk, off].set(vs)
+            else:
+                ck = ck.at[blk, off].set(k[:, 0].astype(ck.dtype))
+                cv = cv.at[blk, off].set(v[:, 0].astype(cv.dtype))
+            if mode == "pallas":
+                # fused block-table walk: the arena is read in physical
+                # blocks, never gathered to [B, S]
+                o = _pa.paged_decode_attention(
+                    q[:, 0] * scale, ck, cv, bt, pos, sk, sv, scale=1.0)
+                o = o.reshape(B, 1, H)
+            else:
+                if quant:
+                    gk = _pa.dequantize_kv(ck[bt], sk[bt]).reshape(
+                        B, S, nh, hd)
+                    gv = _pa.dequantize_kv(cv[bt], sv[bt]).reshape(
+                        B, S, nh, hd)
+                else:
+                    gk = ck[bt].reshape(B, S, nh, hd)
+                    gv = cv[bt].reshape(B, S, nh, hd)
+                logits = jnp.einsum("bqhd,bkhd->bhqk",
+                                    (q * scale).astype(jnp.float32),
+                                    gk.astype(jnp.float32))
+                logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+                p = jax.nn.softmax(logits, axis=-1)
+                o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(gv.dtype), gv)
+                o = o.reshape(B, 1, H)
+            o = o.astype(hh.dtype)
+            a = _mm(o, lw, "proj_w") + lw["proj_b"]
             hh = hh + a
             x = _norm(hh, lw["ln2_w"], lw["ln2_b"], eps)
             if c.num_experts > 0:
@@ -778,16 +862,20 @@ class GPTForCausalLM(Layer):
                     lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
                     capacity_factor=c.moe_capacity_factor)
             else:
-                up = jnp.matmul(x, lw["fc1_w"],
-                                precision=matmul_precision()) + lw["fc1_b"]
-                f = jnp.matmul(jax.nn.gelu(up), lw["fc2_w"],
-                               precision=matmul_precision()) + lw["fc2_b"]
-            return hh + f, (ck, cv)
+                up = _mm(x, lw, "fc1_w") + lw["fc1_b"]
+                f = _mm(jax.nn.gelu(up), lw, "fc2_w") + lw["fc2_b"]
+            return hh + f, ((ck, cv, sk, sv) if quant else (ck, cv))
 
-        h, (pool_k, pool_v) = jax.lax.scan(
-            body, h, (w["lws"], pool_k, pool_v))
+        if quant:
+            h, (pool_k, pool_v, scale_k, scale_v) = jax.lax.scan(
+                body, h, (w["lws"], pool_k, pool_v, scale_k, scale_v))
+        else:
+            h, (pool_k, pool_v) = jax.lax.scan(
+                body, h, (w["lws"], pool_k, pool_v))
         logits = _lm_logits(c, w["wte"], w["lnf_w"], w["lnf_b"], w["head"],
                             h[:, 0])
+        if quant:
+            return logits, pool_k, pool_v, scale_k, scale_v
         return logits, pool_k, pool_v
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,

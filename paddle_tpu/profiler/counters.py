@@ -49,6 +49,13 @@ Well-known names (see README "Observability" for the full table):
   serving.kv.blocks_evicted / serving.kv.pool_exhausted
   serving.kv.prefill_chunks (chunked-prefill program launches)
   serving.kv.blocks_used (gauge: block-pool blocks currently owned)
+  serving.kv.quant.prefill_tokens / serving.kv.quant.decode_tokens
+      (tokens quantized on insert into an int8/fp8 KV arena)
+  serving.kv.quant.arena_bytes / serving.kv.quant.bytes_saved (gauges:
+      quantized arena+scales footprint, and savings vs the model dtype)
+  kernels.paged.pallas_programs / kernels.paged.xla_fallbacks
+      (trace-time: paged decode programs compiled with the fused Pallas
+      backend vs the plain-XLA gather twin; 0 in steady state)
   resilience.saves / resilience.save_ms / resilience.restores
   resilience.resharded_restores (restores onto a different mesh shape)
   resilience.retries / resilience.corrupt_detected

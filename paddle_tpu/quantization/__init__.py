@@ -130,6 +130,89 @@ class QAT:
         return model
 
 
+class PercentileObserver(BaseQuanter):
+    """Clip-to-percentile observer (reference: the PTQ observers under
+    quantization/observers/): the running scale tracks the
+    ``percentile``-th percentile of |x| instead of the absolute max, so a
+    handful of outlier activations can't blow up the quantization grid.
+    """
+
+    def __init__(self, quant_bits=8, percentile=99.99):
+        super().__init__()
+        if not 0.0 < percentile <= 100.0:
+            raise ValueError(f"percentile must be in (0, 100], "
+                             f"got {percentile}")
+        self.bits = quant_bits
+        self.percentile = float(percentile)
+        self.register_buffer("_scale", Tensor(jnp.ones((), jnp.float32)))
+
+    def forward(self, x):
+        m = jnp.percentile(jnp.abs(x._data.astype(jnp.float32)),
+                           self.percentile)
+        self._scale._data = jnp.maximum(self._scale._data, m)
+        return fake_quant(x, Tensor._wrap(self._scale._data), self.bits)
+
+    def scales(self):
+        return self._scale
+
+
 class PTQ(QAT):
     """Post-training quantization (reference: quantization/ptq.py:24)."""
     pass
+
+
+# ---------------------------------------------------------------------------
+# int8 weight-only PTQ over GPT decode-state pytrees
+# ---------------------------------------------------------------------------
+#: stacked [L, in, out] layer weights eligible for weight-only PTQ; MoE
+#: expert weights ([L, E, in, out]) and biases/norms stay full precision.
+PTQ_WEIGHTS = ("qkv_w", "proj_w", "fc1_w", "fc2_w")
+
+
+def channel_scales(w, observer="absmax", percentile=99.99, qmax=127.0):
+    """Per-output-channel symmetric scales for a stacked weight
+    ``w [L, in, out]``: one fp32 scale per (layer, out) channel, shaped
+    ``[L, 1, out]`` so it broadcasts over the contraction result.
+    ``observer="absmax"`` uses the channel max; ``"percentile"`` clips to
+    the given percentile of |w| per channel (outlier-robust)."""
+    wf = jnp.abs(w.astype(jnp.float32))
+    if observer == "absmax":
+        amax = jnp.max(wf, axis=-2)                        # [L, out]
+    elif observer == "percentile":
+        amax = jnp.percentile(wf, percentile, axis=-2)
+    else:
+        raise ValueError(f"observer must be 'absmax' or 'percentile', "
+                         f"got {observer!r}")
+    return (jnp.maximum(amax, 1e-8) / qmax)[:, None, :]    # [L, 1, out]
+
+
+def quantize_weight_int8(w, observer="absmax", percentile=99.99):
+    """``(q_int8 [L, in, out], scale [L, 1, out] fp32)`` such that
+    ``q * scale ~= w`` (symmetric, per-output-channel; values beyond a
+    percentile clip saturate at +-127)."""
+    scale = channel_scales(w, observer, percentile)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def ptq_int8_decode_state(model, observer="absmax", percentile=99.99):
+    """Int8 weight-only PTQ of a GPT serving weight pytree: the
+    ``decode_state()`` dict with every stacked matmul weight in
+    :data:`PTQ_WEIGHTS` replaced by its int8 tensor plus a
+    ``<name>__scale`` fp32 per-output-channel companion.  The serving
+    programs (``models.gpt._mm``) spot the scale key and fold dequant
+    into the matmul epilogue — per-output-channel scales commute with the
+    contraction, so logits match fp32 up to the int8 rounding of the
+    weights.  Embeddings, the LM head, biases, and norms stay full
+    precision; MoE expert stacks (ndim != 3) are skipped."""
+    w = model.decode_state()
+    lws = dict(w["lws"])
+    for name in PTQ_WEIGHTS:
+        v = lws.get(name)
+        if v is None or v.ndim != 3:
+            continue
+        q, scale = quantize_weight_int8(v, observer, percentile)
+        lws[name] = q
+        lws[name + "__scale"] = scale
+    w["lws"] = lws
+    return w

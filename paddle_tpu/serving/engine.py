@@ -199,10 +199,19 @@ class LLMEngine:
     def __init__(self, model, max_slots=8, max_seq_len=None, queue_size=64,
                  min_bucket=8, eos_token_id=None, kv_layout="slots",
                  block_size=16, n_blocks=None, prefill_chunk=None,
-                 prefix_cache=True):
+                 prefix_cache=True, kv_dtype=None, weight_dtype=None):
         if kv_layout not in ("slots", "paged"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}; "
                              "want 'slots' or 'paged'")
+        if kv_dtype not in (None, "int8", "fp8"):
+            raise ValueError(f"kv_dtype must be None, 'int8' or 'fp8', "
+                             f"got {kv_dtype!r}")
+        if kv_dtype is not None and kv_layout != "paged":
+            raise ValueError("kv_dtype requires kv_layout='paged' (the "
+                             "slot arena is not quantized)")
+        if weight_dtype not in (None, "int8"):
+            raise ValueError(f"weight_dtype must be None or 'int8', "
+                             f"got {weight_dtype!r}")
         self.kv_layout = kv_layout
         # paged-arena knobs (used by the PagedLLMEngine _init_kv override;
         # inert under the default slot layout)
@@ -210,6 +219,8 @@ class LLMEngine:
         self.n_blocks = n_blocks
         self.prefill_chunk = prefill_chunk
         self.prefix_caching = bool(prefix_cache)
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = weight_dtype
         c = model.config
         self.model = model
         self.config = c
@@ -222,7 +233,11 @@ class LLMEngine:
         self.queue_size = int(queue_size)
         self.min_bucket = int(min_bucket)
         self.eos_token_id = eos_token_id  # default for requests
-        self._w = model.decode_state()
+        if weight_dtype == "int8":
+            from ..quantization import ptq_int8_decode_state
+            self._w = ptq_int8_decode_state(model)
+        else:
+            self._w = model.decode_state()
 
         B, S = self.max_slots, self.max_seq_len
         nh = c.num_heads

@@ -219,7 +219,8 @@ class ServingFleet:
                  threaded=True, heartbeat_timeout_s=10.0, slo_margin=1.0,
                  max_retries=1, warm_buckets=(), router=None,
                  kv_layout="slots", block_size=16, n_blocks=None,
-                 prefill_chunk=None, prefix_cache=True):
+                 prefill_chunk=None, prefix_cache=True, kv_dtype=None,
+                 weight_dtype=None):
         self.model = model
         self._engine_kw = dict(max_slots=max_slots, max_seq_len=max_seq_len,
                                queue_size=queue_size, min_bucket=min_bucket,
@@ -227,7 +228,9 @@ class ServingFleet:
                                kv_layout=kv_layout, block_size=block_size,
                                n_blocks=n_blocks,
                                prefill_chunk=prefill_chunk,
-                               prefix_cache=prefix_cache)
+                               prefix_cache=prefix_cache,
+                               kv_dtype=kv_dtype,
+                               weight_dtype=weight_dtype)
         self.router = router if router is not None else Router(slo_margin)
         self.threaded = bool(threaded)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..kernels import paged_attention as _pa
 from ..profiler import counters
 from ..profiler import flight
 from ..profiler import metrics
@@ -85,10 +86,32 @@ class PagedLLMEngine(LLMEngine):
         if self.prefill_chunk is None:
             self.prefill_chunk = min(S, 128)
         self.prefill_chunk = max(int(self.prefill_chunk), self.min_bucket)
-        self.pool = BlockPool(self.n_blocks, bs)
+        self.pool = BlockPool(self.n_blocks, bs, kv_dtype=self.kv_dtype)
         self.prefix = PrefixCache(self.pool) if self.prefix_caching else None
-        self._pk = jnp.zeros((c.num_layers, self.n_blocks, bs, nh, hd), dt)
-        self._pv = jnp.zeros((c.num_layers, self.n_blocks, bs, nh, hd), dt)
+        # which attention backend the decode program compiles with —
+        # resolved ONCE at construction (FLAGS_paged_kernel vs platform)
+        # and baked into the program-cache key, so two engines under
+        # different flag values can never silently share a program
+        self.kv_kernel = _pa.kernel_mode()
+        adt = _pa.KV_DTYPES[self.kv_dtype] if self.kv_dtype else dt
+        self._pk = jnp.zeros((c.num_layers, self.n_blocks, bs, nh, hd), adt)
+        self._pv = jnp.zeros((c.num_layers, self.n_blocks, bs, nh, hd), adt)
+        if self.kv_dtype:
+            # per-token fp32 scales at the same (layer, block, position)
+            # address as the quantized tiles (donated alongside them)
+            self._sk = jnp.zeros((c.num_layers, self.n_blocks, bs),
+                                 jnp.float32)
+            self._sv = jnp.zeros((c.num_layers, self.n_blocks, bs),
+                                 jnp.float32)
+            tile = c.num_layers * self.n_blocks * bs * nh * hd
+            raw = 2 * tile * jnp.dtype(dt).itemsize
+            quant = (2 * tile * jnp.dtype(adt).itemsize
+                     + 2 * c.num_layers * self.n_blocks * bs * 4)
+            counters.set_gauge("serving.kv.quant.arena_bytes", quant)
+            counters.set_gauge("serving.kv.quant.bytes_saved",
+                               max(raw - quant, 0))
+        else:
+            self._sk = self._sv = None
         # per-slot block tables (host mirror; rides decode as an operand)
         self._bt = np.zeros((B, self.max_blocks), np.int32)
         self._running = np.zeros(B, np.bool_)
@@ -107,7 +130,7 @@ class PagedLLMEngine(LLMEngine):
         self.kv_pool_exhausted_events = 0
 
     def release_kv(self):
-        self._pk = self._pv = None
+        self._pk = self._pv = self._sk = self._sv = None
 
     def prefix_peek(self, prompt):
         if self.prefix is None:
@@ -124,25 +147,45 @@ class PagedLLMEngine(LLMEngine):
     # closures capture the MODEL only, and jax.jit keys compiled variants
     # by argument shape, so chunk buckets and differing pool sizes each
     # get their own executable while identical engines reuse them.
+    # Engines whose attention backend or KV precision differ get distinct
+    # cache keys (``_prog_key``) — a program traced under one
+    # FLAGS_paged_kernel / kv_dtype must never serve another.
+    def _prog_key(self, base):
+        if self.kv_kernel == "off" and self.kv_dtype is None:
+            return base
+        return f"{base}@{self.kv_kernel}:{self.kv_dtype or 'raw'}"
+
     def _pchunk_for(self, bucket):
         fn = self._pchunk_jits.get(bucket)
         if fn is None:
             progs = _model_programs(self.model)
-            fn = progs.get("prefill_paged")
+            key = self._prog_key("prefill_paged")
+            fn = progs.get(key)
             if fn is None:
                 model = self.model
-
-                def pchunk(w, ids, start, length, bt, pk, pv, key_data,
-                           do_sample, temp, top_k, top_p):
-                    counters.inc("serving.retraces")  # trace-time only
-                    pk, pv, logits = model.prefill_paged(
-                        w, ids, start, length, bt, pk, pv)
-                    tok, new_key = LLMEngine._first_token(
-                        logits, jax.random.wrap_key_data(key_data),
-                        do_sample, temp, top_k, top_p)
-                    return pk, pv, tok, new_key
-                fn = progs["prefill_paged"] = jax.jit(
-                    pchunk, donate_argnums=(5, 6))
+                if self.kv_dtype:
+                    def pchunk(w, ids, start, length, bt, pk, pv, sk, sv,
+                               key_data, do_sample, temp, top_k, top_p):
+                        counters.inc("serving.retraces")  # trace-time only
+                        pk, pv, sk, sv, logits = model.prefill_paged(
+                            w, ids, start, length, bt, pk, pv, sk, sv)
+                        tok, new_key = LLMEngine._first_token(
+                            logits, jax.random.wrap_key_data(key_data),
+                            do_sample, temp, top_k, top_p)
+                        return pk, pv, sk, sv, tok, new_key
+                    fn = jax.jit(pchunk, donate_argnums=(5, 6, 7, 8))
+                else:
+                    def pchunk(w, ids, start, length, bt, pk, pv, key_data,
+                               do_sample, temp, top_k, top_p):
+                        counters.inc("serving.retraces")  # trace-time only
+                        pk, pv, logits = model.prefill_paged(
+                            w, ids, start, length, bt, pk, pv)
+                        tok, new_key = LLMEngine._first_token(
+                            logits, jax.random.wrap_key_data(key_data),
+                            do_sample, temp, top_k, top_p)
+                        return pk, pv, tok, new_key
+                    fn = jax.jit(pchunk, donate_argnums=(5, 6))
+                progs[key] = fn
             self._pchunk_jits[bucket] = fn
             counters.set_gauge("serving.prefill_programs",
                                len(self._pchunk_jits))
@@ -151,15 +194,14 @@ class PagedLLMEngine(LLMEngine):
     def _pdecode(self):
         if self._pdecode_jit is None:
             progs = _model_programs(self.model)
-            fn = progs.get("decode_paged")
+            key = self._prog_key("decode_paged")
+            fn = progs.get(key)
             if fn is None:
                 model = self.model
+                mode = self.kv_kernel
 
-                def decode(w, pk, pv, bt, tok, pos, keys_data, do_sample,
-                           temp, top_k, top_p):
-                    counters.inc("serving.retraces")
-                    logits, pk, pv = model.decode_paged(
-                        w, tok, pos, bt, pk, pv)
+                def sample_next(logits, keys_data, do_sample, temp, top_k,
+                                top_p):
                     keys = jax.random.wrap_key_data(keys_data)
                     pair = jax.vmap(jax.random.split)(keys)
                     new_keys, kstep = pair[:, 0], pair[:, 1]
@@ -172,34 +214,80 @@ class PagedLLMEngine(LLMEngine):
                     greedy = jnp.argmax(logits, axis=-1)
                     nxt = jnp.where(do_sample, sampled,
                                     greedy).astype(jnp.int32)
-                    return nxt, pk, pv, jax.random.key_data(new_keys)
-                fn = progs["decode_paged"] = jax.jit(
-                    decode, donate_argnums=(1, 2))
+                    return nxt, jax.random.key_data(new_keys)
+
+                if self.kv_dtype:
+                    def decode(w, pk, pv, sk, sv, bt, tok, pos, keys_data,
+                               do_sample, temp, top_k, top_p):
+                        counters.inc("serving.retraces")
+                        logits, pk, pv, sk, sv = model.decode_paged(
+                            w, tok, pos, bt, pk, pv, sk, sv, kernel=mode)
+                        nxt, new_keys = sample_next(
+                            logits, keys_data, do_sample, temp, top_k,
+                            top_p)
+                        return nxt, pk, pv, sk, sv, new_keys
+                    fn = jax.jit(decode, donate_argnums=(1, 2, 3, 4))
+                else:
+                    def decode(w, pk, pv, bt, tok, pos, keys_data,
+                               do_sample, temp, top_k, top_p):
+                        counters.inc("serving.retraces")
+                        logits, pk, pv = model.decode_paged(
+                            w, tok, pos, bt, pk, pv, kernel=mode)
+                        nxt, new_keys = sample_next(
+                            logits, keys_data, do_sample, temp, top_k,
+                            top_p)
+                        return nxt, pk, pv, new_keys
+                    fn = jax.jit(decode, donate_argnums=(1, 2))
+                progs[key] = fn
             self._pdecode_jit = fn
         return self._pdecode_jit
 
     def _pcopy(self):
         """Copy-on-write block clone: ``dst[:nvalid] = src[:nvalid]``,
-        zero beyond (one fixed-shape donated program)."""
+        zero beyond (one fixed-shape donated program; the quantized
+        variant clones the per-token scale rows alongside the tiles)."""
         if self._pcopy_jit is None:
             progs = _model_programs(self.model)
-            fn = progs.get("copy_block")
+            key = self._prog_key("copy_block")
+            fn = progs.get(key)
             if fn is None:
-                def copyb(pk, pv, src, dst, nvalid):
-                    counters.inc("serving.retraces")
+                def _clone_block(pk, pv, src, dst, nvalid):
                     bs = pk.shape[2]
                     valid = (jnp.arange(bs) < nvalid)[None, :, None, None]
                     kb = jnp.where(valid, jax.lax.dynamic_slice_in_dim(
-                        pk, src, 1, axis=1)[:, 0], 0)
+                        pk, src, 1, axis=1)[:, 0],
+                        jnp.zeros((), pk.dtype))
                     vb = jnp.where(valid, jax.lax.dynamic_slice_in_dim(
-                        pv, src, 1, axis=1)[:, 0], 0)
+                        pv, src, 1, axis=1)[:, 0],
+                        jnp.zeros((), pv.dtype))
                     pk = jax.lax.dynamic_update_slice(
                         pk, kb[:, None], (0, dst, 0, 0, 0))
                     pv = jax.lax.dynamic_update_slice(
                         pv, vb[:, None], (0, dst, 0, 0, 0))
                     return pk, pv
-                fn = progs["copy_block"] = jax.jit(
-                    copyb, donate_argnums=(0, 1))
+
+                if self.kv_dtype:
+                    def copyb(pk, pv, sk, sv, src, dst, nvalid):
+                        counters.inc("serving.retraces")
+                        pk, pv = _clone_block(pk, pv, src, dst, nvalid)
+                        bs = sk.shape[2]
+                        sval = (jnp.arange(bs) < nvalid)[None, :]
+                        skb = jnp.where(sval, jax.lax.dynamic_slice_in_dim(
+                            sk, src, 1, axis=1)[:, 0], 0.0)
+                        svb = jnp.where(sval, jax.lax.dynamic_slice_in_dim(
+                            sv, src, 1, axis=1)[:, 0], 0.0)
+                        sk = jax.lax.dynamic_update_slice(
+                            sk, skb[:, None], (0, dst, 0))
+                        sv = jax.lax.dynamic_update_slice(
+                            sv, svb[:, None], (0, dst, 0))
+                        return pk, pv, sk, sv
+                    fn = jax.jit(copyb, donate_argnums=(0, 1, 2, 3))
+                else:
+                    def copyb(pk, pv, src, dst, nvalid):
+                        counters.inc("serving.retraces")
+                        return _clone_block(pk, pv, src, dst, nvalid)
+                    fn = jax.jit(copyb, donate_argnums=(0, 1))
+                progs[key] = fn
             self._pcopy_jit = fn
         return self._pcopy_jit
 
@@ -267,16 +355,27 @@ class PagedLLMEngine(LLMEngine):
                 # request's first private tail block before extending it
                 t0_cow = time.perf_counter_ns() if tr is not None else 0
                 cp = self._pcopy()
-                cargs = (self._pk, self._pv, np.int32(pnode.block),
-                         np.int32(table[len(shared)]), np.int32(p))
+                scalars = (np.int32(pnode.block),
+                           np.int32(table[len(shared)]), np.int32(p))
+                if self.kv_dtype:
+                    cargs = (self._pk, self._pv, self._sk, self._sv,
+                             *scalars)
+                    dn = (0, 1, 2, 3)
+                else:
+                    cargs = (self._pk, self._pv, *scalars)
+                    dn = (0, 1)
                 self._maybe_capture("serving.kv.copy_block", cp, *cargs)
                 self._maybe_audit("serving.kv.copy_block", cp, *cargs,
-                                  donate_argnums=(0, 1))
+                                  donate_argnums=dn)
                 # the reservation (pool alloc + table + COW adopt) must be
                 # atomic w.r.t. concurrent cancel/router stats, so this one
                 # bounded block-copy dispatch stays under the lock
                 # ptlint: disable=PT005 reason="COW adopt is part of the atomic reservation; a bounded one-block copy, not a per-token dispatch"
-                self._pk, self._pv = cp(*cargs)
+                out = cp(*cargs)
+                if self.kv_dtype:
+                    self._pk, self._pv, self._sk, self._sv = out
+                else:
+                    self._pk, self._pv = out
                 if tr is not None:
                     tr.add_span("cow.adopt", t0_cow,
                                 time.perf_counter_ns(), tokens=p)
@@ -356,19 +455,32 @@ class PagedLLMEngine(LLMEngine):
         t0_tr = time.perf_counter_ns() if tr is not None else 0
         with span("serving.prefill"):
             pf = self._pchunk_for(C)
-            pargs = (self._w, jnp.asarray(ids), np.int32(start),
-                     np.int32(take_n), jnp.asarray(self._bt[slot]),
-                     self._pk, self._pv, key_data,
-                     np.bool_(req.do_sample), np.float32(req.temperature),
-                     np.int32(req.top_k), np.float32(req.top_p))
+            head = (self._w, jnp.asarray(ids), np.int32(start),
+                    np.int32(take_n), jnp.asarray(self._bt[slot]))
+            tail = (key_data, np.bool_(req.do_sample),
+                    np.float32(req.temperature), np.int32(req.top_k),
+                    np.float32(req.top_p))
+            if self.kv_dtype:
+                pargs = (*head, self._pk, self._pv, self._sk, self._sv,
+                         *tail)
+                dn = (5, 6, 7, 8)
+            else:
+                pargs = (*head, self._pk, self._pv, *tail)
+                dn = (5, 6)
             self._maybe_capture(f"serving.prefill_paged[c{C}]", pf, *pargs)
             self._maybe_audit(f"serving.prefill_paged[c{C}]", pf, *pargs,
-                              donate_argnums=(5, 6))
-            self._pk, self._pv, tok, new_key = pf(*pargs)
+                              donate_argnums=dn)
+            if self.kv_dtype:
+                (self._pk, self._pv, self._sk, self._sv, tok,
+                 new_key) = pf(*pargs)
+            else:
+                self._pk, self._pv, tok, new_key = pf(*pargs)
         if tr is not None:
             tr.add_span("prefill.chunk", t0_tr, time.perf_counter_ns(),
                         chunk=C, start=start, take=take_n)
         counters.inc("serving.kv.prefill_chunks")
+        if self.kv_dtype:
+            counters.inc("serving.kv.quant.prefill_tokens", take_n)
         st["done"] = start + take_n
         if last:
             del self._prefill_state[slot]
@@ -425,15 +537,25 @@ class PagedLLMEngine(LLMEngine):
         t0_tr = time.perf_counter_ns() if tr_on else 0
         with span("serving.decode"):
             dec = self._pdecode()
-            dargs = (self._w, self._pk, self._pv, jnp.asarray(bt_eff),
-                     jnp.asarray(self._tok), jnp.asarray(pos_eff),
-                     jnp.asarray(self._keys), jnp.asarray(self._dosample),
-                     jnp.asarray(self._temp), jnp.asarray(self._topk),
-                     jnp.asarray(self._topp))
+            tail = (jnp.asarray(bt_eff), jnp.asarray(self._tok),
+                    jnp.asarray(pos_eff), jnp.asarray(self._keys),
+                    jnp.asarray(self._dosample), jnp.asarray(self._temp),
+                    jnp.asarray(self._topk), jnp.asarray(self._topp))
+            if self.kv_dtype:
+                dargs = (self._w, self._pk, self._pv, self._sk, self._sv,
+                         *tail)
+                dn = (1, 2, 3, 4)
+            else:
+                dargs = (self._w, self._pk, self._pv, *tail)
+                dn = (1, 2)
             self._maybe_capture("serving.decode_paged", dec, *dargs)
             self._maybe_audit("serving.decode_paged", dec, *dargs,
-                              donate_argnums=(1, 2))
-            nxt, self._pk, self._pv, new_keys = dec(*dargs)
+                              donate_argnums=dn)
+            if self.kv_dtype:
+                (nxt, self._pk, self._pv, self._sk, self._sv,
+                 new_keys) = dec(*dargs)
+            else:
+                nxt, self._pk, self._pv, new_keys = dec(*dargs)
             nxt = np.asarray(nxt)
         if tr_on:
             t1_tr = time.perf_counter_ns()
@@ -449,6 +571,8 @@ class PagedLLMEngine(LLMEngine):
                              + (1 - self._ema_alpha) * self._tps_ema)
         counters.inc("serving.decode_steps")
         counters.inc("serving.decode_tokens", len(active))
+        if self.kv_dtype:
+            counters.inc("serving.kv.quant.decode_tokens", len(active))
         for s, req in active:
             self._tok[s] = nxt[s]
             self._pos[s] += 1
@@ -516,6 +640,9 @@ class PagedLLMEngine(LLMEngine):
             st = super().stats()
             st.update({
                 "kv_layout": "paged",
+                "kv_dtype": self.kv_dtype,
+                "kv_kernel": self.kv_kernel,
+                "weight_dtype": self.weight_dtype,
                 "prefill_programs": len(self._pchunk_jits),
                 "block_size": self.pool.block_size,
                 "blocks_total": self.pool.capacity,

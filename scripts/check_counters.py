@@ -347,6 +347,140 @@ def run():
     if not pc_chunks < nc_chunks:
         violations["paged-prefix:chunks"] = (pc_chunks, f"<{nc_chunks}")
 
+    # ---- paged Pallas-kernel + quantized-KV gate ------------------------
+    # The fused Pallas decode kernel (interpret mode on CPU) and the int8
+    # arena must be drop-in twins of the plain-XLA paged engine: pallas is
+    # TOKEN-identical (greedy and seeded sampling), quantized KV / PTQ
+    # weights hold the documented logit-tolerance gate
+    # (max |drift| <= 5% of the fp32 logit magnitude), and both keep the
+    # steady-state economics — distinct program-cache keys, ONE decode
+    # program per backend (kernels.paged.* tick once, at trace time), and
+    # zero retraces in a warm measure window.
+    import jax.numpy as jnp
+    from paddle_tpu.core import flags as pflags
+    from paddle_tpu.kernels import paged_attention as _pa
+    from paddle_tpu.quantization import ptq_int8_decode_state
+
+    pq_prompts = [rng.randint(0, 64, size=n).tolist() for n in (5, 9)]
+    pq_sample = dict(do_sample=True, temperature=0.9, top_k=8)
+
+    def pq_engine(**kw):
+        return LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4,
+                         kv_layout="paged", block_size=4, prefill_chunk=8,
+                         **kw)
+
+    def pq_run(eng_, sampled=False):
+        hs = [eng_.add_request(p, max_new_tokens=3, seed=21 + i,
+                               **(pq_sample if sampled else {}))
+              for i, p in enumerate(pq_prompts)]
+        while not all(h.is_finished for h in hs):
+            eng_.step()
+        return [list(h.tokens) for h in hs]
+
+    pq_base = pq_engine()
+    base_greedy = pq_run(pq_base)
+    base_sampled = pq_run(pq_base, sampled=True)
+
+    _pa._INTERPRET[0] = True
+    pflags.set_flags({"FLAGS_paged_kernel": "pallas"})
+    try:
+        kbefore = counters.snapshot()
+        pk_eng = pq_engine()
+        if pk_eng.stats()["kv_kernel"] != "pallas":
+            violations["paged-pallas:kv_kernel"] = (
+                pk_eng.stats()["kv_kernel"], "pallas")
+        pk_greedy = pq_run(pk_eng)              # traces the pallas decode
+        pk_sampled = pq_run(pk_eng, sampled=True)
+        kwarm = counters.delta(kbefore)
+        # the fused backend actually compiled, and never fell back
+        if kwarm.get("kernels.paged.pallas_programs", 0) < 1:
+            violations["paged-pallas:programs"] = (
+                kwarm.get("kernels.paged.pallas_programs", 0), ">=1")
+        if kwarm.get("kernels.paged.xla_fallbacks", 0):
+            violations["paged-pallas:fallbacks"] = (
+                kwarm.get("kernels.paged.xla_fallbacks", 0), 0)
+        if pk_greedy != base_greedy:
+            violations["paged-pallas:greedy_identity"] = (pk_greedy,
+                                                          base_greedy)
+        if pk_sampled != base_sampled:
+            violations["paged-pallas:sampled_identity"] = (pk_sampled,
+                                                           base_sampled)
+        # warm steady window: every program (incl. the kernel) cached
+        ksbefore = counters.snapshot()
+        pq_run(pk_eng)
+        ksteady = counters.delta(ksbefore)
+        for k in ("serving.retraces", "jit.traces", "jit.hydrates",
+                  "jit.syncs", "kernels.paged.pallas_programs",
+                  "kernels.paged.xla_fallbacks"):
+            if ksteady.get(k, 0):
+                violations[f"paged-pallas:{k}"] = (ksteady.get(k, 0), 0)
+    finally:
+        pflags.set_flags({"FLAGS_paged_kernel": "off"})
+        _pa._INTERPRET[0] = False
+
+    # int8 arena twin: greedy-identical on the tiny model, ONE decode
+    # program for the whole engine lifetime, zero steady retraces
+    qbefore = counters.snapshot()
+    pq_q = pq_engine(kv_dtype="int8")
+    q_greedy = pq_run(pq_q)
+    qwarm = counters.delta(qbefore)
+    if q_greedy != base_greedy:
+        violations["paged-quant:greedy_identity"] = (q_greedy, base_greedy)
+    if qwarm.get("kernels.paged.xla_fallbacks", 0) != 1:
+        violations["paged-quant:decode_programs"] = (
+            qwarm.get("kernels.paged.xla_fallbacks", 0), 1)
+    if not qwarm.get("serving.kv.quant.prefill_tokens", 0):
+        violations["paged-quant:prefill_tokens"] = (0, ">0")
+    if counters.get("serving.kv.quant.bytes_saved") <= 0:
+        violations["paged-quant:bytes_saved"] = (
+            counters.get("serving.kv.quant.bytes_saved"), ">0")
+    qsbefore = counters.snapshot()
+    pq_run(pq_q)
+    qsteady = counters.delta(qsbefore)
+    for k in ("serving.retraces", "jit.traces", "jit.hydrates",
+              "jit.syncs", "kernels.paged.xla_fallbacks"):
+        if qsteady.get(k, 0):
+            violations[f"paged-quant:{k}"] = (qsteady.get(k, 0), 0)
+
+    # the documented logit-tolerance gate, direct-call: quantized-KV
+    # prefill logits and PTQ-int8 weights vs the fp32 reference
+    QUANT_LOGIT_TOL = 0.05
+    sw = smodel.decode_state()
+    L_, nh_ = scfg.num_layers, scfg.num_heads
+    hd_ = scfg.hidden_size // scfg.num_heads
+    sdt = jnp.dtype(scfg.dtype)
+    qids = jnp.asarray(rng.randint(0, 64, size=(1, 16)), jnp.int32)
+    qbt = jnp.arange(4, dtype=jnp.int32)                # 16 tokens, bs=4
+    _, _, ref_logits = smodel.prefill_paged(
+        sw, qids, 0, 16, qbt,
+        jnp.zeros((L_, 4, 4, nh_, hd_), sdt),
+        jnp.zeros((L_, 4, 4, nh_, hd_), sdt))
+    ref_l = np.asarray(ref_logits)
+    quant_drift = {}
+    for kvd in ("int8", "fp8"):
+        adt = _pa.KV_DTYPES[kvd]
+        out = smodel.prefill_paged(
+            sw, qids, 0, 16, qbt,
+            jnp.zeros((L_, 4, 4, nh_, hd_), adt),
+            jnp.zeros((L_, 4, 4, nh_, hd_), adt),
+            jnp.zeros((L_, 4, 4), jnp.float32),
+            jnp.zeros((L_, 4, 4), jnp.float32))
+        drift = float(np.abs(np.asarray(out[-1]) - ref_l).max())
+        quant_drift[f"kv_{kvd}"] = drift
+        if drift > QUANT_LOGIT_TOL * float(np.abs(ref_l).max()):
+            violations[f"paged-quant:{kvd}_logits"] = (
+                drift, f"<={QUANT_LOGIT_TOL}*max|ref|")
+    _, _, slot_ref = smodel.prefill_slot(sw, qids, 16)
+    _, _, slot_ptq = smodel.prefill_slot(ptq_int8_decode_state(smodel),
+                                         qids, 16)
+    ptq_drift = float(np.abs(np.asarray(slot_ptq)
+                             - np.asarray(slot_ref)).max())
+    quant_drift["ptq_int8"] = ptq_drift
+    if ptq_drift > QUANT_LOGIT_TOL * float(
+            np.abs(np.asarray(slot_ref)).max()):
+        violations["paged-quant:ptq_logits"] = (
+            ptq_drift, f"<={QUANT_LOGIT_TOL}*max|ref|")
+
     # ---- elastic-fleet gate: zero lost under churn, warm replicas -------
     from paddle_tpu.resilience import faultinject
     from paddle_tpu.serving import ServingFleet
@@ -794,6 +928,9 @@ def run():
               "paged_prefix": {"hits": pc_hits,
                                "chunks_cached": pc_chunks,
                                "chunks_nocache": nc_chunks},
+              "paged_pallas_steady_delta": ksteady,
+              "paged_quant_steady_delta": qsteady,
+              "paged_quant_logit_drift": quant_drift,
               "fleet_steady_delta": flsteady,
               "fleet_churn_delta": {k: v for k, v in chsteady.items()
                                     if k.startswith("serving.fleet.")},

@@ -192,6 +192,9 @@ class TestDevicePrefetcher:
 
 
 class TestBenchSmoke:
+    # ~40s of serial phase compiles; scripts/check_counters.py gates the
+    # same counter contracts (and more) outside the tier-1 time budget.
+    @pytest.mark.slow
     def test_bench_smoke_counter_contract(self):
         import importlib.util
         import pathlib
