@@ -33,6 +33,13 @@ Legs (perf round 5):
   reporting TTFT p50/p95 and gating prefix-cache hits with strictly
   fewer prefill-chunk launches than a no-cache twin; decode tok/s
   parity vs the slot engine is reported informationally.
+- gpt125m_spec (speculative-decoding leg): an aligned draft/target pair
+  (shared embeddings, zeroed transformer blocks — acceptance ~1.0, so the
+  leg measures the draft/verify machinery's ceiling) served greedily by
+  ``LLMEngine(draft_model=..., kv_layout="paged")`` vs the non-spec paged
+  baseline on the same prompts — reports acceptance rate, draft/verify
+  dispatch counts, and net decode tok/s, gating token identity, zero
+  steady retraces, ``accepted + rejected == drafted`` and ≥1.3× speedup.
 - gpt125m_fleet (elastic-fleet leg): the same seeded request set through
   a 2-replica ``serving.ServingFleet`` clean, then with one replica
   killed mid-decode (``faultinject`` ``replica_crash``) — reports decode
@@ -61,7 +68,7 @@ the fleet leg additionally smoke-hits the live ops endpoint (OpsServer
 ckpt leg embeds save-latency percentiles; the mesh legs embed
 per-compiled-program HBM bytes ("hbm") captured via XLA memory analysis
 under FLAGS_device_telemetry.
-Set PTPU_BENCH=125m|760m|serve|paged|ckpt|fleet|mesh|mesh760m to run a
+Set PTPU_BENCH=125m|760m|serve|paged|paged_q|spec|ckpt|fleet|mesh|mesh760m to run a
 single leg.  PTPU_FUSED_STEPS sets the fused window length K (default 4; 1
 disables the fused leg).  PTPU_MESH picks the mesh leg's axis degrees.
 """
@@ -707,6 +714,148 @@ def _run_paged_q_leg(cfg, n_requests=64, max_new=64, max_slots=4,
     return leg
 
 
+def _run_spec_leg(n_requests=16, max_new=32, max_slots=4, min_bucket=8,
+                  block_size=16, prefill_chunk=64, spec_k=4, hidden=512,
+                  layers=12, draft_layers=1, vocab=512, seq_len=256,
+                  seed=0, min_speedup=1.3):
+    """Speculative-decoding leg: draft/verify engine vs the non-spec
+    paged baseline on the same greedy workload.
+
+    The model pair is ALIGNED by construction: both share the embedding /
+    final-norm weights and every transformer block's matmul weights are
+    zeroed (a zero block contributes nothing to the residual stream but
+    still costs its full matmul FLOPs/bytes), so draft and target emit
+    the same greedy chain and acceptance sits at ~1.0 — the leg measures
+    the MACHINERY's ceiling (one [B, K+1] verify amortizes the target's
+    weight sweep over up to K+1 tokens) rather than any particular
+    trained draft's acceptance.  The target is many zeroed layers deep so
+    its weight sweep dominates; the draft is ``draft_layers`` of the same
+    width.
+
+    Gates: speculative greedy output token-identical to the baseline
+    engine; zero steady-state retraces over the measured window;
+    ``accepted + rejected == drafted``; net decode tok/s >=
+    ``min_speedup`` x the baseline (the CPU-fallback gate — the weight
+    sweep is bandwidth-bound on CPU exactly as on TPU)."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.profiler import counters
+    from paddle_tpu.serving import LLMEngine
+    from paddle_tpu.serving.kvcache import blocks_for_tokens
+
+    def build(n_layers, seed_):
+        cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                        num_layers=n_layers, num_heads=8,
+                        max_seq_len=seq_len, use_rope=True,
+                        use_flash_attention=False, dtype="float32")
+        paddle.seed(seed_)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        for n in ("qkv_w", "qkv_b", "proj_w", "proj_b",
+                  "fc1_w", "fc1_b", "fc2_w", "fc2_b"):
+            p = getattr(m, n)
+            p._data = jnp.zeros_like(p._data)
+        return m
+
+    target = build(layers, seed)
+    draft = build(draft_layers, seed + 1)
+    for n in ("wte", "lnf_w", "lnf_b"):
+        getattr(draft, n)._data = getattr(target, n)._data
+
+    rng = np.random.RandomState(seed)
+    plen = max(2, seq_len // 8)
+    prompts = [rng.randint(0, vocab, size=plen).tolist()
+               for _ in range(n_requests)]
+    n_blocks = 2 * max_slots * blocks_for_tokens(seq_len, block_size) + 1
+
+    def engine(**kw):
+        eng = LLMEngine(target, max_slots=max_slots, max_seq_len=seq_len,
+                        min_bucket=min_bucket, kv_layout="paged",
+                        block_size=block_size, n_blocks=n_blocks,
+                        prefill_chunk=prefill_chunk, prefix_cache=False,
+                        **kw)
+        b, pwarm = min_bucket, []
+        while b <= eng.prefill_chunk:
+            pwarm.append(rng.randint(0, vocab,
+                                     size=min(b, seq_len - 3)).tolist())
+            b *= 2
+        for _ in eng.generate(pwarm, max_new_tokens=2):
+            pass
+        return eng
+
+    def serve(eng):
+        hs = [eng.add_request(p, max_new_tokens=max_new, seed=i)
+              for i, p in enumerate(prompts)]
+        t0 = time.perf_counter()
+        while not all(h.is_finished for h in hs):
+            eng.step()
+        return hs, time.perf_counter() - t0
+
+    beng = engine()
+    bhs, base_s = serve(beng)
+    base_tps = n_requests * max_new / max(base_s, 1e-9)
+    base_snap = beng.histogram_snapshot()
+    del beng
+
+    seng = engine(draft_model=draft, spec_k=spec_k)
+    before = counters.snapshot()
+    shs, spec_s = serve(seng)
+    delta = counters.delta(before)
+    spec_tps = n_requests * max_new / max(spec_s, 1e-9)
+    for b, s in zip(bhs, shs):
+        if b.tokens != s.tokens:
+            raise AssertionError(
+                "spec leg: speculative greedy output diverged from the "
+                "non-speculative paged engine")
+    if delta.get("serving.retraces", 0):
+        raise AssertionError(
+            f"spec leg: {delta['serving.retraces']} steady retraces on "
+            "the speculative engine (want 0)")
+    drafted = delta.get("serving.spec.drafted", 0)
+    accepted = delta.get("serving.spec.accepted", 0)
+    rejected = delta.get("serving.spec.rejected", 0)
+    if accepted + rejected != drafted:
+        raise AssertionError(
+            f"spec leg: accepted {accepted} + rejected {rejected} != "
+            f"drafted {drafted}")
+    speedup = spec_tps / max(base_tps, 1e-9)
+    if speedup < min_speedup:
+        raise AssertionError(
+            f"spec leg: speculative decode {spec_tps:.1f} tok/s vs "
+            f"baseline {base_tps:.1f} = {speedup:.2f}x (want >= "
+            f"{min_speedup}x)")
+    spec_snap = seng.histogram_snapshot()
+    st = seng.stats()
+    leg = {"spec_k": spec_k,
+           "requests": n_requests,
+           "max_new_tokens": max_new,
+           "prompt_tokens": plen,
+           "target_layers": layers,
+           "draft_layers": draft_layers,
+           "hidden": hidden,
+           "drafted": drafted,
+           "accepted": accepted,
+           "rejected": rejected,
+           "acceptance_rate": round(accepted / max(1, drafted), 4),
+           "acceptance_ema": st["spec_acceptance_ema"],
+           "yield_ema": round(st["spec_yield_ema"], 3),
+           "verify_steps": delta.get("serving.spec.verify_steps", 0),
+           "draft_steps": delta.get("serving.spec.draft_steps", 0),
+           "rollback_blocks": delta.get("serving.spec.rollback_blocks",
+                                        0),
+           "steady_retraces": delta.get("serving.retraces", 0),
+           "decode_tokens_per_sec_base": round(base_tps, 2),
+           "decode_tokens_per_sec_spec": round(spec_tps, 2),
+           "spec_speedup": round(speedup, 4),
+           "ttft_base": _latency_ms(base_snap["serving.ttft_ns"]),
+           "ttft_spec": _latency_ms(spec_snap["serving.ttft_ns"]),
+           "itl_base": _latency_ms(base_snap["serving.itl_ns"]),
+           "itl_spec": _latency_ms(spec_snap["serving.itl_ns"])}
+    del seng, target, draft
+    return leg
+
+
 def _run_fleet_leg(cfg, replicas=2, n_requests=8, max_new=32, max_slots=4,
                    min_bucket=8, seed=0):
     """Elastic-fleet leg: the same seeded request set through a
@@ -1045,6 +1194,15 @@ def main():
                                           max_slots=2, min_bucket=4,
                                           block_size=4, prefill_chunk=16,
                                           n_verify=4)
+        # tiny speculative leg: greedy identity + counter-identity gates
+        # and the >=1.3x net decode speedup of the aligned draft/target
+        # pair (the target's zeroed-weight sweep is bandwidth-bound on
+        # CPU too, so the verify amortization is measurable off-TPU)
+        out["spec"] = _run_spec_leg(n_requests=8, max_new=16,
+                                    max_slots=4, min_bucket=4,
+                                    block_size=8, prefill_chunk=16,
+                                    hidden=512, layers=12, vocab=512,
+                                    seq_len=128)
         # tiny fleet leg: durability gates (zero lost, respawn == kills,
         # churn output identical) always; throughput informational on CPU
         out["fleet"] = _run_fleet_leg(cfg, replicas=2, n_requests=4,
@@ -1063,10 +1221,11 @@ def main():
 
     which = os.environ.get("PTPU_BENCH", "all")
     if which not in ("all", "760m", "125m", "serve", "paged", "paged_q",
-                     "ckpt", "fleet", "mesh", "mesh760m"):
+                     "spec", "ckpt", "fleet", "mesh", "mesh760m"):
         raise SystemExit(
             f"PTPU_BENCH={which!r}: expected "
-            f"all|760m|125m|serve|paged|paged_q|ckpt|fleet|mesh|mesh760m")
+            f"all|760m|125m|serve|paged|paged_q|spec|ckpt|fleet|mesh|"
+            f"mesh760m")
     mesh_degrees = _parse_mesh_degrees(os.environ.get("PTPU_MESH", "dp2"))
     mesh_ndev = int(np.prod(list(mesh_degrees.values())))
     legs = {}
@@ -1155,6 +1314,16 @@ def main():
                                                    max_new=64, max_slots=4,
                                                    block_size=16,
                                                    prefill_chunk=256)
+    if which in ("all", "spec"):
+        # speculative-decoding leg: aligned draft/target pair (shared
+        # embeddings, zeroed blocks -> acceptance ~1.0) at gpt125m width
+        # and depth — acceptance rate, net decode tok/s vs the non-spec
+        # paged baseline (>= 1.3x), TTFT/ITL tails, zero steady retraces
+        legs["gpt125m_spec"] = _run_spec_leg(n_requests=32, max_new=64,
+                                             max_slots=8, hidden=768,
+                                             layers=12, vocab=50304,
+                                             seq_len=1024, block_size=16,
+                                             prefill_chunk=256)
     if which in ("all", "fleet"):
         # elastic-fleet leg: multi-replica throughput with and without
         # one replica killed mid-decode (acceptance: zero lost requests,
@@ -1210,6 +1379,17 @@ def main():
             "value": leg["decode_tokens_per_sec"],
             "unit": "tokens/s",
             "vs_baseline": leg["churn_retention"],  # vs one replica killed
+            "legs": legs,
+        }))
+        return
+    if set(legs) == {"gpt125m_spec"}:  # spec-only run: speedup line
+        leg = legs["gpt125m_spec"]
+        print(json.dumps({
+            "metric": "gpt125m_spec_decode_tokens_per_sec",
+            "value": leg["decode_tokens_per_sec_spec"],
+            "unit": "tokens/s",
+            "vs_baseline": leg["spec_speedup"],  # vs non-spec paged
+            "acceptance_rate": leg["acceptance_rate"],
             "legs": legs,
         }))
         return

@@ -152,6 +152,21 @@ def _rope_rows(x, pos, base=10000.0):
     return out.astype(x.dtype)
 
 
+def _rope_grid(x, pos, base=10000.0):
+    """apply_rope for a grid of tokens ``x[B, T, nh, hd]`` sitting at
+    arbitrary PER-TOKEN positions ``pos[B, T]`` (the speculative-verify
+    twin of ``_rope_rows``: each draft position gets its own rotation)."""
+    b, s, h, d = x.shape
+    inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = pos.astype(jnp.float32)[..., None] * inv  # [B, T, d/2]
+    sin = jnp.sin(freqs)[:, :, None, :]
+    cos = jnp.cos(freqs)[:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return out.astype(x.dtype)
+
+
 def _mm(x, lw, name):
     """Layer matmul against a decode-state weight that may be int8
     weight-only quantized (``quantization.ptq_int8_decode_state`` stores
@@ -874,6 +889,125 @@ class GPTForCausalLM(Layer):
                 body, h, (w["lws"], pool_k, pool_v))
         logits = _lm_logits(c, w["wte"], w["lnf_w"], w["lnf_b"], w["head"],
                             h[:, 0])
+        if quant:
+            return logits, pool_k, pool_v, scale_k, scale_v
+        return logits, pool_k, pool_v
+
+    def verify_paged(self, w, toks, pos0, n_valid, bt, pool_k, pool_v,
+                     scale_k=None, scale_v=None):
+        """Speculative-decoding verify step: score K+1 token positions
+        per row in ONE program over the block-pool arena (the multi-query
+        sibling of ``decode_paged``; see ``serving.speculative``).
+
+        ``toks[B, K1]`` holds each row's last committed token followed by
+        K draft proposals; ``pos0[B]`` is the committed token's position,
+        so ``toks[b, j]`` sits at logical position ``pos0[b] + j``.
+        ``n_valid[B]`` (1..K1) caps how many of the K1 positions are real
+        for the row — writes for ``j >= n_valid`` are routed to the trash
+        block 0 so a row near its token budget can ride the same
+        fixed-shape program without its KV overrunning the blocks the
+        admission reservation pinned.  Each valid token's K/V is
+        scattered at ``bt[b, (pos0+j) // bs]`` offset ``(pos0+j) % bs``
+        (overwriting any stale rejected-draft KV from earlier rounds —
+        rollback never copies), and query ``j`` attends its own causal
+        prefix ``kpos <= pos0 + j`` over the gathered logical sequence.
+        Returns ``(logits[B, K1, V] fp32, pool_k, pool_v)`` — logits at
+        EVERY drafted position, from which the engine's acceptance rule
+        keeps a prefix of the draft and samples the correction/bonus
+        token.  Quantized-KV mode mirrors ``decode_paged``: per-token
+        fp32 scale arenas ride the donated carry and the return grows to
+        ``(logits, pool_k, pool_v, scale_k, scale_v)``."""
+        c = self.config
+        nh = c.num_heads
+        eps = c.layer_norm_epsilon
+        H = c.hidden_size
+        hd = H // nh
+        B, K1 = toks.shape
+        n_blocks, bs = pool_k.shape[1], pool_k.shape[2]
+        max_blocks = bt.shape[1]
+        S = max_blocks * bs
+        scale = 1.0 / math.sqrt(hd)
+        pos = pos0[:, None] + jnp.arange(K1)[None, :]            # [B, K1]
+        valid = jnp.arange(K1)[None, :] < n_valid[:, None]       # [B, K1]
+        h = jnp.take(w["wte"], toks, axis=0)                     # [B, K1, H]
+        if w["wpe"] is not None:
+            h = h + jnp.take(w["wpe"], jnp.minimum(pos, w["wpe"].shape[0] - 1),
+                             axis=0)
+        rows = jnp.arange(B)
+        # invalid positions may index past the table; the where() routes
+        # them to the trash block before any write can land
+        blk = jnp.where(valid, bt[rows[:, None],
+                                  jnp.minimum(pos // bs, max_blocks - 1)], 0)
+        off = pos % bs
+        kpos = jnp.arange(S)
+        mask = kpos[None, None, :] <= pos[:, :, None]            # [B, K1, S]
+        quant = scale_k is not None
+        kv_dt = _pa.kv_dtype_of(pool_k.dtype) if quant else None
+
+        def body(hh, xs):
+            if quant:
+                lw, ck, cv, sk, sv = xs
+            else:
+                lw, ck, cv = xs
+                sk = sv = None
+            x = _norm(hh, lw["ln1_w"], lw["ln1_b"], eps)
+            qkv = _mm(x, lw, "qkv_w") + lw["qkv_b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, K1, nh, hd)
+            k = k.reshape(B, K1, nh, hd)
+            v = v.reshape(B, K1, nh, hd)
+            if c.use_rope:
+                q = _rope_grid(q, pos)
+                k = _rope_grid(k, pos)
+            if quant:
+                kq, ks = _pa.quantize_kv(k, kv_dt)
+                vq, vs = _pa.quantize_kv(v, kv_dt)
+                ck = ck.at[blk, off].set(kq)
+                cv = cv.at[blk, off].set(vq)
+                sk = sk.at[blk, off].set(ks)
+                sv = sv.at[blk, off].set(vs)
+            else:
+                ck = ck.at[blk, off].set(k.astype(ck.dtype))
+                cv = cv.at[blk, off].set(v.astype(cv.dtype))
+            # gather AFTER the scatter: query j sees the committed prefix
+            # plus every draft token at or before its own position
+            if quant:
+                gk = _pa.dequantize_kv(ck[bt], sk[bt]).reshape(
+                    B, S, nh, hd)
+                gv = _pa.dequantize_kv(cv[bt], sv[bt]).reshape(
+                    B, S, nh, hd)
+            else:
+                gk = ck[bt].reshape(B, S, nh, hd)
+                gv = cv[bt].reshape(B, S, nh, hd)
+            logits = jnp.einsum("bqhd,bkhd->bhqk",
+                                (q * scale).astype(jnp.float32),
+                                gk.astype(jnp.float32))
+            logits = jnp.where(mask[:, None], logits, NEG_INF)
+            p = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(gv.dtype), gv)
+            o = o.reshape(B, K1, H).astype(hh.dtype)
+            a = _mm(o, lw, "proj_w") + lw["proj_b"]
+            hh = hh + a
+            x = _norm(hh, lw["ln2_w"], lw["ln2_b"], eps)
+            if c.num_experts > 0:
+                from ..incubate.moe import moe_ffn
+                f, _aux = moe_ffn(
+                    x, lw["gate_w"], lw["fc1_w"], lw["fc1_b"],
+                    lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
+                    capacity_factor=c.moe_capacity_factor)
+            else:
+                up = _mm(x, lw, "fc1_w") + lw["fc1_b"]
+                f = _mm(jax.nn.gelu(up), lw, "fc2_w") + lw["fc2_b"]
+            return hh + f, ((ck, cv, sk, sv) if quant else (ck, cv))
+
+        if quant:
+            h, (pool_k, pool_v, scale_k, scale_v) = jax.lax.scan(
+                body, h, (w["lws"], pool_k, pool_v, scale_k, scale_v))
+        else:
+            h, (pool_k, pool_v) = jax.lax.scan(
+                body, h, (w["lws"], pool_k, pool_v))
+        logits = _lm_logits(c, w["wte"], w["lnf_w"], w["lnf_b"], w["head"],
+                            h)
         if quant:
             return logits, pool_k, pool_v, scale_k, scale_v
         return logits, pool_k, pool_v

@@ -53,6 +53,19 @@ Well-known names (see README "Observability" for the full table):
       (tokens quantized on insert into an int8/fp8 KV arena)
   serving.kv.quant.arena_bytes / serving.kv.quant.bytes_saved (gauges:
       quantized arena+scales footprint, and savings vs the model dtype)
+  serving.spec.drafted / serving.spec.accepted / serving.spec.rejected
+      (speculative decoding proposal outcomes; accepted + rejected ==
+      drafted, every scheduler round)
+  serving.spec.draft_steps / serving.spec.verify_steps (speculative
+      dispatches: K+1 draft launches + ONE verify launch per round)
+  serving.spec.draft_prefill_chunks (draft-namespace chunked prefill)
+  serving.spec.draft_starved (rounds a slot drafted nothing because the
+      pool could not cover its draft-table growth; throughput-only)
+  serving.spec.rollback_blocks (draft blocks released by post-verify
+      block-table truncation — rejection rollback, no device copies)
+  serving.spec.acceptance / serving.spec.yield (gauges: acceptance-rate
+      EMA and emitted-tokens-per-round-per-slot EMA)
+  serving.fleet.spec_acceptance (gauge: drafted-weighted fleet mean)
   kernels.paged.pallas_programs / kernels.paged.xla_fallbacks
       (trace-time: paged decode programs compiled with the fused Pallas
       backend vs the plain-XLA gather twin; 0 in steady state)

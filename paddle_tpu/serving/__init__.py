@@ -16,10 +16,12 @@ from .kvcache import (BlockPool, BlockPoolExhausted,  # noqa: F401
                       PrefixCache, blocks_for_tokens)
 from .paged import PagedLLMEngine  # noqa: F401
 from .router import RetryAfter, Router  # noqa: F401
-from .sampling import filter_logits, sample_tokens  # noqa: F401
+from .sampling import filter_logits, residual_sample, sample_tokens  # noqa: F401
+from .speculative import SpeculativeLLMEngine  # noqa: F401
 
-__all__ = ["LLMEngine", "PagedLLMEngine", "Request", "EngineBackpressure",
-           "EngineClosed", "bucket_length", "filter_logits",
-           "sample_tokens", "ServingFleet", "FleetRequest", "Replica",
+__all__ = ["LLMEngine", "PagedLLMEngine", "SpeculativeLLMEngine", "Request",
+           "EngineBackpressure", "EngineClosed", "bucket_length",
+           "filter_logits", "sample_tokens", "residual_sample",
+           "ServingFleet", "FleetRequest", "Replica",
            "Router", "RetryAfter", "BlockPool", "BlockPoolExhausted",
            "PrefixCache", "blocks_for_tokens"]

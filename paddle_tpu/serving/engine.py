@@ -190,7 +190,12 @@ class LLMEngine:
     def __new__(cls, *args, **kw):
         # kv_layout="paged" routes construction to the paged subclass so
         # `LLMEngine(model, kv_layout="paged")` is the one public spelling
-        # (serving.paged imports this module; resolve lazily)
+        # (serving.paged imports this module; resolve lazily); a
+        # draft_model= routes further to the speculative engine, which
+        # runs over the paged arena
+        if cls is LLMEngine and kw.get("draft_model") is not None:
+            from .speculative import SpeculativeLLMEngine
+            return super().__new__(SpeculativeLLMEngine)
         if cls is LLMEngine and kw.get("kv_layout", "slots") == "paged":
             from .paged import PagedLLMEngine
             return super().__new__(PagedLLMEngine)
@@ -488,6 +493,20 @@ class LLMEngine:
                       max_new_tokens=req.max_new_tokens)
         return req
 
+    def _note_decode(self, emitted, elapsed_s):
+        """Fold one decode launch into the tokens/s EMA.  ``emitted`` is
+        the number of tokens the launch actually DELIVERED — one per
+        active slot for plain decode, up to K+1 per slot for a
+        speculative verify round — never the dispatch count, so Router
+        SLO shedding and least-loaded dispatch
+        (``backlog / decode_tps_ema``) stay correct whatever the
+        per-dispatch token yield."""
+        inst = emitted / max(elapsed_s, 1e-9)
+        with self._cond:
+            self._tps_ema = (inst if self._tps_ema <= 0 else
+                             self._ema_alpha * inst
+                             + (1 - self._ema_alpha) * self._tps_ema)
+
     def _retry_hint_locked(self):
         """Seconds until the current backlog drains at the EMA decode
         rate; None before the first decode launch.  Caller holds _cond."""
@@ -691,11 +710,8 @@ class LLMEngine:
                     r.trace.add_span("decode.iter", t0_tr, t1_tr,
                                      batch=len(active))
         self._keys = np.array(new_keys)  # mutable host copy
-        inst = len(active) / max(time.perf_counter() - t0, 1e-9)
-        with self._cond:
-            self._tps_ema = (inst if self._tps_ema <= 0 else
-                             self._ema_alpha * inst
-                             + (1 - self._ema_alpha) * self._tps_ema)
+        # one token emitted per active slot this launch
+        self._note_decode(len(active), time.perf_counter() - t0)
         counters.inc("serving.decode_steps")
         counters.inc("serving.decode_tokens", len(active))
         for s, req in active:

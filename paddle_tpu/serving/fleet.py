@@ -220,7 +220,7 @@ class ServingFleet:
                  max_retries=1, warm_buckets=(), router=None,
                  kv_layout="slots", block_size=16, n_blocks=None,
                  prefill_chunk=None, prefix_cache=True, kv_dtype=None,
-                 weight_dtype=None):
+                 weight_dtype=None, draft_model=None, spec_k=4):
         self.model = model
         self._engine_kw = dict(max_slots=max_slots, max_seq_len=max_seq_len,
                                queue_size=queue_size, min_bucket=min_bucket,
@@ -231,6 +231,12 @@ class ServingFleet:
                                prefix_cache=prefix_cache,
                                kv_dtype=kv_dtype,
                                weight_dtype=weight_dtype)
+        if draft_model is not None:
+            # every replica runs draft/verify speculative decoding; the
+            # compiled draft + verify programs are shared fleet-wide
+            # through the per-model program registry
+            self._engine_kw.update(draft_model=draft_model,
+                                   spec_k=spec_k)
         self.router = router if router is not None else Router(slo_margin)
         self.threaded = bool(threaded)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
@@ -771,4 +777,20 @@ class ServingFleet:
                 "pool_exhausted": sum(st["pool_exhausted"]
                                       for st in paged),
             }
+        spec = [st for st in reps
+                if st.get("speculative") and st["alive"]]
+        if spec:
+            # fleet-wide acceptance: drafted-token-weighted mean across
+            # replicas (NOT a mean of EMAs — a replica that drafted 10x
+            # the tokens should weigh 10x), published for SLO dashboards
+            drafted = sum(st["spec_drafted"] for st in spec)
+            accepted = sum(st["spec_accepted"] for st in spec)
+            acc = accepted / max(1, drafted)
+            out["spec"] = {
+                "spec_k": spec[0]["spec_k"],
+                "drafted": drafted,
+                "accepted": accepted,
+                "acceptance": acc,
+            }
+            counters.set_gauge("serving.fleet.spec_acceptance", acc)
         return out

@@ -564,11 +564,8 @@ class PagedLLMEngine(LLMEngine):
                     r.trace.add_span("decode.iter", t0_tr, t1_tr,
                                      batch=len(active))
         self._keys = np.array(new_keys)  # mutable host copy
-        inst = len(active) / max(time.perf_counter() - t0, 1e-9)
-        with self._cond:
-            self._tps_ema = (inst if self._tps_ema <= 0 else
-                             self._ema_alpha * inst
-                             + (1 - self._ema_alpha) * self._tps_ema)
+        # one token emitted per active slot this launch
+        self._note_decode(len(active), time.perf_counter() - t0)
         counters.inc("serving.decode_steps")
         counters.inc("serving.decode_tokens", len(active))
         if self.kv_dtype:

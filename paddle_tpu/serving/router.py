@@ -148,7 +148,21 @@ class Router:
             counters.inc("serving.fleet.prefix_routed")
         backlog = st["outstanding_tokens"]   # SLO math on the REAL backlog
         if shed and deadline_s is not None and st["decode_tps_ema"] > 0:
-            est_done_s = (backlog + est_tokens) / st["decode_tps_ema"]
+            tps = st["decode_tps_ema"]
+            acc = st.get("spec_acceptance_ema")
+            yld = st.get("spec_yield_ema", 0.0)
+            if acc is not None and yld > 0:
+                # speculative replica: the tokens/s EMA was measured at
+                # the RECENT per-round yield, but the yield a NEW request
+                # gets depends on how its drafts fare — re-anchor the
+                # throughput estimate from the observed yield to the
+                # acceptance-implied expected yield (1 + acc*K accepted
+                # drafts + correction per round), so a yield collapse
+                # (adversarial prompts) sheds earlier and a hot draft
+                # admits more
+                k = st.get("spec_k", 0)
+                tps = tps * (1.0 + acc * k) / max(yld, 1e-6)
+            est_done_s = (backlog + est_tokens) / tps
             if est_done_s * self.slo_margin > float(deadline_s):
                 counters.inc("serving.fleet.shed")
                 raise RetryAfter(

@@ -67,3 +67,22 @@ def sample_tokens(lg, key, *, do_sample=True, temperature=1.0, top_k=0,
         return jnp.argmax(lg, axis=-1).astype(out_dtype)
     flg = filter_logits(lg, temperature, top_k, top_p)
     return jax.random.categorical(key, flg, axis=-1).astype(out_dtype)
+
+
+def residual_sample(p, q, key, out_dtype=jnp.int32):
+    """Draw from the speculative-decoding residual distribution
+    ``norm(max(0, p - q))`` (Leviathan et al., ICML 2023, eq. for the
+    rejection fallback).  ``p`` is the target model's probability row(s)
+    ``[..., V]``, ``q`` the draft's; when a drafted token is rejected the
+    correction draw from this residual keeps the OVERALL output
+    distribution exactly equal to sampling from ``p`` alone.
+
+    Degenerate rows where ``q >= p`` everywhere (residual mass 0, only
+    possible up to float rounding since both sum to 1) fall back to
+    sampling from ``p`` itself — a measure-zero guard, not a bias."""
+    res = jnp.maximum(p - q, 0.0)
+    mass = jnp.sum(res, axis=-1, keepdims=True)
+    safe = res / jnp.maximum(mass, 1e-20)
+    dist = jnp.where(mass > 0.0, safe, p)
+    lg = jnp.log(jnp.maximum(dist, 1e-30))
+    return jax.random.categorical(key, lg, axis=-1).astype(out_dtype)

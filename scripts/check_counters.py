@@ -70,6 +70,17 @@ workload; with sample=1, every finished engine request's stage spans
 TTFT + decode wall clock — the span tree accounts for the latency the
 histograms report.
 
+A ninth phase gates speculative decoding
+(``LLMEngine(draft_model=...)``): greedy speculative output must be
+token-identical to the non-speculative paged engine, a warm measured
+window must dispatch only cached programs (zero retraces / traces /
+hydrates / syncs) while the engine's whole lifetime compiled exactly
+ONE draft decode + ONE verify program, and the acceptance ledger must
+balance exactly — ``serving.spec.accepted + rejected == drafted`` with
+K+1 draft launches + ONE verify launch per round.  The program-audit
+phase additionally serves through a speculative engine under
+``FLAGS_program_audit=enforce`` with OFF/ON counter parity.
+
 Prints one JSON line; raises AssertionError on any violation.  Wired as a
 tier-1 test via tests/test_profiler.py.  Run directly:
 ``python scripts/check_counters.py``.
@@ -481,6 +492,67 @@ def run():
         violations["paged-quant:ptq_logits"] = (
             ptq_drift, f"<={QUANT_LOGIT_TOL}*max|ref|")
 
+    # ---- speculative gate: draft/verify fixed-shape economics -----------
+    # Greedy speculative output is token-identical to the non-spec paged
+    # engine for ANY draft model; a warm measured window dispatches only
+    # CACHED programs — zero retraces / traces / hydrates / syncs — and
+    # the engine's whole lifetime compiled exactly ONE draft decode
+    # program and ONE verify program (the one-program/zero-steady-retrace
+    # economics); the acceptance ledger balances exactly every round:
+    # accepted + rejected == drafted, K+1 draft launches + ONE verify.
+    from paddle_tpu.serving.engine import _model_programs
+    from paddle_tpu.serving.kvcache import blocks_for_tokens
+
+    paddle.seed(7)
+    sdraft = GPTForCausalLM(GPTConfig(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=4,
+        max_seq_len=32, use_flash_attention=False))
+    sdraft.eval()
+    SPEC_K = 2
+    SPEC_NB = 2 * 2 * blocks_for_tokens(32, 4) + 1   # both namespaces
+
+    def spec_engine():
+        # prefix cache off so warm and measured runs chunk identically
+        return LLMEngine(smodel, draft_model=sdraft, spec_k=SPEC_K,
+                         kv_layout="paged", max_slots=2, max_seq_len=32,
+                         min_bucket=4, block_size=4, prefill_chunk=8,
+                         n_blocks=SPEC_NB, prefix_cache=False)
+
+    sp_eng = spec_engine()
+    sp_greedy = pq_run(sp_eng)    # warm: compiles draft + verify programs
+    if sp_greedy != base_greedy:
+        violations["spec:greedy_identity"] = (sp_greedy, base_greedy)
+    spbefore = counters.snapshot()
+    sp_greedy2 = pq_run(sp_eng)   # measured: every program cached
+    spsteady = counters.delta(spbefore)
+    if sp_greedy2 != base_greedy:
+        violations["spec:greedy_identity_warm"] = (sp_greedy2, base_greedy)
+    for k in ("serving.retraces", "jit.traces", "jit.hydrates",
+              "jit.syncs"):
+        if spsteady.get(k, 0):
+            violations[f"spec:{k}"] = (spsteady.get(k, 0), 0)
+    sp_drafted = spsteady.get("serving.spec.drafted", 0)
+    if not sp_drafted:
+        violations["spec:drafted"] = (sp_drafted, ">0")
+    sp_balance = (spsteady.get("serving.spec.accepted", 0)
+                  + spsteady.get("serving.spec.rejected", 0))
+    if sp_balance != sp_drafted:
+        violations["spec:ledger"] = (sp_balance, sp_drafted)
+    sp_rounds = spsteady.get("serving.spec.verify_steps", 0)
+    if (not sp_rounds or spsteady.get("serving.spec.draft_steps", 0)
+            != (SPEC_K + 1) * sp_rounds):
+        violations["spec:round_dispatches"] = (
+            spsteady.get("serving.spec.draft_steps", 0),
+            f"{SPEC_K + 1} * {sp_rounds}")
+    spec_dkeys = [k for k in _model_programs(sdraft) if isinstance(k, str)
+                  and k.startswith("serving.draft_paged")]
+    spec_vkeys = [k for k in _model_programs(smodel) if isinstance(k, str)
+                  and k.startswith("serving.verify_paged")]
+    if len(spec_dkeys) != 1:
+        violations["spec:draft_programs"] = (spec_dkeys, 1)
+    if len(spec_vkeys) != 1:
+        violations["spec:verify_programs"] = (spec_vkeys, 1)
+
     # ---- elastic-fleet gate: zero lost under churn, warm replicas -------
     from paddle_tpu.resilience import faultinject
     from paddle_tpu.serving import ServingFleet
@@ -847,6 +919,13 @@ def run():
         ahc = p4.add_request(acw, max_new_tokens=3)
         while not ahc.is_finished:
             p4.step()
+        # speculative engine: audits the draft-prefill chunk, draft
+        # decode and verify programs at their compile/warmup sites
+        sp4 = LLMEngine(smodel, draft_model=sdraft, spec_k=SPEC_K,
+                        kv_layout="paged", max_slots=2, max_seq_len=32,
+                        min_bucket=4, block_size=4, prefill_chunk=8,
+                        n_blocks=SPEC_NB, prefix_cache=False)
+        sv(sp4, SERVE_LENS_WARM)
 
         b = counters.snapshot()
         for _ in range(MEASURE):
@@ -858,6 +937,7 @@ def run():
                 amstep(x, y).numpy()
         sv(e4, SERVE_LENS_MEASURE)
         sv(p4, SERVE_LENS_MEASURE)
+        sv(sp4, SERVE_LENS_MEASURE)
         return _pick(counters.delta(b))
 
     pflags.set_flags({"FLAGS_program_audit": "off"})
@@ -931,6 +1011,12 @@ def run():
               "paged_pallas_steady_delta": ksteady,
               "paged_quant_steady_delta": qsteady,
               "paged_quant_logit_drift": quant_drift,
+              "spec_steady_delta": {k: v for k, v in spsteady.items()
+                                    if k.startswith(("serving.spec.",
+                                                     "serving.retraces",
+                                                     "jit."))},
+              "spec_programs": {"draft": spec_dkeys,
+                                "verify": spec_vkeys},
               "fleet_steady_delta": flsteady,
               "fleet_churn_delta": {k: v for k, v in chsteady.items()
                                     if k.startswith("serving.fleet.")},
