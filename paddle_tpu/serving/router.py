@@ -156,12 +156,19 @@ class Router:
                 # the RECENT per-round yield, but the yield a NEW request
                 # gets depends on how its drafts fare — re-anchor the
                 # throughput estimate from the observed yield to the
-                # acceptance-implied expected yield (1 + acc*K accepted
-                # drafts + correction per round), so a yield collapse
-                # (adversarial prompts) sheds earlier and a hot draft
-                # admits more
+                # acceptance-implied expected yield.  Under the per-token
+                # acceptance model a round emits 1 + sum_{i=1..k} acc^i
+                # tokens in expectation (the prefix geometric sum, NOT
+                # 1 + acc*k, which overestimates and would delay
+                # shedding), so a yield collapse (adversarial prompts)
+                # sheds earlier and a hot draft admits more
                 k = st.get("spec_k", 0)
-                tps = tps * (1.0 + acc * k) / max(yld, 1e-6)
+                if acc >= 1.0:
+                    exp_yield = 1.0 + float(k)
+                else:
+                    exp_yield = (1.0 + acc * (1.0 - acc ** k)
+                                 / (1.0 - acc))
+                tps = tps * exp_yield / max(yld, 1e-6)
             est_done_s = (backlog + est_tokens) / tps
             if est_done_s * self.slo_margin > float(deadline_s):
                 counters.inc("serving.fleet.shed")

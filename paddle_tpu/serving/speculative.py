@@ -91,7 +91,11 @@ def _acceptance(logits, toks, q, nv, keys_data, do_sample, temp, top_k,
     ``x`` with probability ``min(1, p(x)/q(x))`` (as ``u*q(x) < p(x)``,
     which also accepts ``q(x)=0`` proposals outright), reject into a
     ``residual_sample`` draw, bonus-sample from ``p`` after a clean
-    sweep.  Greedy rows accept while the proposal equals the target
+    sweep.  Only an actual failed acceptance test counts as rejection —
+    running out of draft budget (``nv < K+1``) is not one, so truncated
+    rows still draw their final token from ``p``, keeping the marginal
+    exactly the target distribution at every emitted position.  Greedy
+    rows accept while the proposal equals the target
     argmax and emit the argmax at the first mismatch — the target's own
     greedy chain, bitwise."""
     B, K1, V = logits.shape
@@ -111,23 +115,29 @@ def _acceptance(logits, toks, q, nv, keys_data, do_sample, temp, top_k,
         filter_logits(lg, t, tk, tp), axis=-1))(logits, temp, top_k, top_p)
     greedy = jnp.argmax(logits, axis=-1)                      # [B, K1]
     acc = jnp.zeros(B, jnp.int32)
-    alive = jnp.ones(B, bool)
+    rej = jnp.zeros(B, bool)
     for j in range(K):
         tokj = toks[:, j + 1]
         ptok = p[:, j][rows, tokj]
         qtok = q[:, j][rows, tokj]
         ok_s = u[:, j] * qtok < ptok
         ok_g = tokj == greedy[:, j]
-        ok = jnp.where(do_sample, ok_s, ok_g) & alive & (j < nv - 1)
-        acc = acc + ok.astype(jnp.int32)
-        alive = alive & ok
+        # a proposal is CONSIDERED only inside the row's draft budget and
+        # before its first rejection — budget exhaustion is not a
+        # rejection, so a truncated round (nv < K+1: final-token and
+        # draft-starved rows) must still bonus-sample from p, never from
+        # the residual
+        considered = ~rej & (j < nv - 1)
+        ok = jnp.where(do_sample, ok_s, ok_g)
+        rej = rej | (considered & ~ok)
+        acc = acc + (considered & ok).astype(jnp.int32)
     pin = p[rows, acc]                                        # [B, V]
     qin = q[rows, jnp.minimum(acc, K - 1)]
     t_res = jax.vmap(residual_sample)(pin, qin, k_res)
     t_bonus = jax.vmap(lambda kk, pr: jax.random.categorical(
         kk, jnp.log(jnp.maximum(pr, 1e-30))))(k_bonus, pin)
     t_fin = jnp.where(do_sample,
-                      jnp.where(alive, t_bonus, t_res),
+                      jnp.where(rej, t_res, t_bonus),
                       greedy[rows, acc]).astype(jnp.int32)
     tpad = jnp.concatenate([toks[:, 1:], jnp.zeros((B, 1), toks.dtype)],
                            axis=1)
@@ -464,7 +474,14 @@ class SpeculativeLLMEngine(PagedLLMEngine):
         dready = np.zeros(self.max_slots, np.bool_)
         with self._cond:
             for s in range(self.max_slots):
-                if not self._running[s] or self._dslot_blocks[s] is None:
+                if not self._running[s]:
+                    continue
+                if self._dslot_blocks[s] is None:
+                    # no draft table at all: its proposals would have
+                    # been drafted against the trash block — degrade to
+                    # plain decode like the pool-exhausted path
+                    nv[s] = 1
+                    counters.inc("serving.spec.draft_starved")
                     continue
                 tbl = self._dslot_blocks[s]
                 need = blocks_for_tokens(int(self._pos[s]) + int(nv[s]),

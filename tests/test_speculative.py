@@ -229,6 +229,41 @@ class TestDistributionPreservation:
         crit = df + 3.1 * np.sqrt(2 * df) + 12
         assert stat < crit, (stat, crit, df)
 
+    def test_truncated_round_final_token_samples_from_target(self):
+        """Budget exhaustion is NOT rejection: a row whose round is
+        truncated below K+1 considered proposals (nv=1 here — the final
+        token of every sampled request, and draft-starved rows) must draw
+        its token from the target distribution ``p``, not from the
+        residual ``norm(max(0, p - q))``.  Regression: the old acceptance
+        folded ``j < nv-1`` into the accept bit, which read as a
+        rejection and made every token where ``q >= p`` unsampleable at
+        truncated positions."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.serving.speculative import _acceptance
+
+        B, K1, V = 4096, 4, 4
+        p0 = np.asarray([0.5, 0.3, 0.15, 0.05])
+        q0 = np.asarray([0.9, 0.05, 0.03, 0.02])   # q > p at token 0
+        logits = jnp.broadcast_to(jnp.log(jnp.asarray(p0, jnp.float32)),
+                                  (B, K1, V))
+        q = jnp.broadcast_to(jnp.asarray(q0, jnp.float32),
+                             (B, K1 - 1, V))
+        toks = jnp.zeros((B, K1), jnp.int32)
+        nv = jnp.ones(B, jnp.int32)               # zero considered drafts
+        keys_data = jax.random.key_data(
+            jax.random.split(jax.random.key(11), B))
+        emit, n_emit, _ = _acceptance(
+            logits, toks, q, nv, keys_data,
+            jnp.ones(B, bool), jnp.ones(B, jnp.float32),
+            jnp.zeros(B, jnp.int32), jnp.ones(B, jnp.float32))
+        assert np.all(np.asarray(n_emit) == 1)
+        freq = np.bincount(np.asarray(emit)[:, 0], minlength=V) / B
+        # 4096 draws: binomial std <= 0.008 per bin — 0.04 is ~5 sigma.
+        # Under the residual bug freq[0] would be ~0 (residual mass at
+        # token 0 is exactly zero), not ~0.5.
+        assert np.abs(freq - p0).max() < 0.04, (freq, p0)
+
     def test_sampled_run_completes_and_counts_balance(self):
         target, draft = _models()
         rng = np.random.default_rng(3)
@@ -282,6 +317,34 @@ class TestKVRollbackAccounting:
         assert spec.pool.used_blocks <= max_target
         assert all(t is None for t in spec._dslot_blocks)
         assert not spec._dbt.any()
+
+    def test_missing_draft_table_degrades_to_plain_decode(self):
+        """A running row whose draft table is gone must be downgraded to
+        ``nv=1`` (``serving.spec.draft_starved``) instead of verifying
+        proposals drafted against the trash block — the round degrades to
+        plain decode and the greedy chain stays token-identical."""
+        target, draft = _models()
+        spec = _spec(target, draft, max_slots=2, prefix_cache=False)
+        prompt = [1, 2, 3, 4, 5]
+        ref = _ref_generate(target, prompt, 10)
+        h = spec.add_request(prompt, max_new_tokens=10)
+        while not any(r is not None and r.state == "running"
+                      for r in spec._slots):
+            spec.step()
+        s = next(i for i, r in enumerate(spec._slots)
+                 if r is not None and r.state == "running")
+        with spec._cond:
+            dbl = spec._dslot_blocks[s]
+            spec._dslot_blocks[s] = None
+            spec._dbt[s] = 0
+            for b in dbl:
+                spec.pool.release(b)
+        before = counters.snapshot()
+        _run(spec, [h])
+        d = counters.delta(before)
+        assert d.get("serving.spec.draft_starved", 0) > 0
+        assert h.tokens == ref and h.finish_reason == "length"
+        assert spec.pool.used_blocks == 0
 
     def test_pool_exhaustion_defers_not_crashes(self):
         """A pool too small for two doubled-namespace residents admits
