@@ -31,8 +31,16 @@ Pieces:
   (compile / step / data_wait / ckpt_sync / restore_replay / recovery /
   idle) for the FaultTolerantTrainer; goodput fraction + >=99%-accounted
   chaos gate.
+* ``health`` — the derived-signals layer: ``HealthMonitor`` snapshot
+  ring over the whole registry, windowed deltas/rates/percentiles,
+  multi-window burn-rate ``SLO`` objectives, live invariant
+  ``Watchdog``s (retrace storm, KV block conservation, goodput
+  accounting, speculative-acceptance collapse), alert lifecycle with
+  flight-dump postmortems, and the single ``admission_level``
+  recommendation (gated by ``FLAGS_health``; zero-overhead off).
 * ``ops`` — ``OpsServer``: stdlib-HTTP live endpoint (``/metrics``,
-  ``/healthz``, ``/goodput``, ``/traces/<id>``, ``/flight``),
+  ``/healthz``, ``/goodput``, ``/traces/<id>``, ``/flight``,
+  ``/alerts``, ``/slo``, ``/signals``),
   fleet-aggregated via the Router (``scripts/ops_server.py`` CLI).
 * ``Profiler`` — the paddle.profiler front end: scheduler state machine,
   ``on_trace_ready`` handlers (``export_chrome_tracing``), ``summary()``,
@@ -57,7 +65,9 @@ from . import goodput  # noqa: F401
 from . import host_tracer  # noqa: F401
 from . import metrics  # noqa: F401
 from . import trace  # noqa: F401
+from . import health  # noqa: F401
 from .goodput import GoodputLedger  # noqa: F401
+from .health import SLO, HealthMonitor, Watchdog  # noqa: F401
 from .host_tracer import current_stack, span  # noqa: F401
 from .metrics import (Histogram, MetricsLogger, memory_summary,  # noqa: F401
                       prometheus_text)

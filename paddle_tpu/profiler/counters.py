@@ -94,6 +94,13 @@ Well-known names (see README "Observability" for the full table):
   analysis.findings / analysis.findings.<rule> (audit invariant
       violations: donation-dropped / host-callback / dynamic-shape /
       f64-promotion / collective-budget / hbm-budget / trace-error)
+  health.ticks (HealthMonitor snapshot ticks; 0 when FLAGS_health off —
+      the zero-overhead-off gate of the health plane)
+  health.alerts.fired / health.alerts.fired.<rule> (0->1 alert
+      transitions: one flight dump per fire, deduped while firing)
+  health.alerts.resolved / health.alerts.resolved.<rule>
+  health.admission_level (gauge: 0 ok / 1 degraded / 2 critical — the
+      recommendation Router/fleet stats()["health"] expose)
 
 Latency *distributions* (serving.ttft_ns, serving.itl_ns,
 serving.queue_wait_ns, io.prefetch_stall_ns, resilience.save_ms, ...)

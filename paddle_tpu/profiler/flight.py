@@ -44,6 +44,17 @@ _SEQ = itertools.count()
 _LAST_DUMP = [None]
 _BASELINE = [_counters.snapshot()]
 _DIR_OVERRIDE = [None]
+_HEALTH_PROVIDER = [None]
+
+
+def set_health_provider(fn):
+    """Register a callable returning the health plane's JSON-safe state
+    (active alerts + last window) to embed into every dump bundle, or
+    None when the plane is off.  ``profiler.health`` installs one at
+    import; kept as a late-bound hook so flight never imports health
+    (no cycle) and dumps stay health-free in processes that never load
+    it."""
+    _HEALTH_PROVIDER[0] = fn
 
 
 def configure(directory=None, capacity=None):
@@ -111,7 +122,8 @@ def dump(reason, context=None, path=None):
          "counters": {name: value},              # full current snapshot
          "counters_delta": {name: movement},     # since startup / clear()
          "histograms": {name: {count,sum,mean,min,max,p50,p95,p99}},
-         "events": [{"ts_ns": int, "kind": str, ...fields}, ...]}  # oldest first
+         "events": [{"ts_ns": int, "kind": str, ...fields}, ...],  # oldest first
+         "health": {"admission_level", "alerts", "window"}}  # when plane is on
     """
     from . import metrics as _metrics
     with _LOCK:
@@ -128,6 +140,14 @@ def dump(reason, context=None, path=None):
             "events": [dict(_json_safe(f), ts_ns=ts, kind=kind)
                        for ts, kind, f in ring],
         }
+        provider = _HEALTH_PROVIDER[0]
+        if provider is not None:
+            try:
+                hstate = provider()
+            except Exception:
+                hstate = None
+            if hstate is not None:
+                bundle["health"] = _json_safe(hstate)
         if path is None:
             d = dump_dir()
             os.makedirs(d, exist_ok=True)

@@ -124,6 +124,44 @@ class Histogram:
         out.merge(self)
         return out
 
+    def delta(self, prev):
+        """Element-wise bucket movement since ``prev`` (a fresh
+        :class:`Histogram` holding only the samples recorded after
+        ``prev`` was captured).  The health plane's windowed-percentile
+        primitive: ``cur.delta(prev).percentile(95)`` is the p95 of the
+        WINDOW, not of process lifetime.
+
+        Reset-safe: if ``prev`` is not a prefix of ``self`` (count or any
+        bucket shrank — ``reset_metrics`` ran between the snapshots),
+        ``prev`` is treated as a zero baseline and the full current state
+        is returned.  Exact ``min``/``max`` of the window samples are not
+        recoverable from bucket counts, so the delta's min/max are the
+        bounds of its outermost non-empty buckets (keeps percentile
+        clamping sane)."""
+        if prev is self:
+            return Histogram(self.name, self.unit)
+        with prev._lock:
+            pb = list(prev._buckets)
+            pc, psum = prev.count, prev.sum
+        with self._lock:
+            cb = list(self._buckets)
+            cc, csum = self.count, self.sum
+        if cc < pc or any(c < p for c, p in zip(cb, pb)):
+            pb = [0] * _NBUCKETS       # counter reset: restart from zero
+            pc, psum = 0, 0.0
+        out = Histogram(self.name, self.unit)
+        out._buckets = [c - p for c, p in zip(cb, pb)]
+        out.count = cc - pc
+        out.sum = csum - psum
+        for i, n in enumerate(out._buckets):
+            if n:
+                lo, hi = _bucket_bounds(i)
+                out.min = min(out.min, lo)
+                out.max = max(out.max, hi)
+        if out.count:
+            out.min = max(out.min, 0.0)
+        return out
+
     def percentile(self, q):
         """Nearest-rank percentile from the bucket counts (0 when empty).
         ``q`` in [0, 100]."""
@@ -325,8 +363,12 @@ def _prom_name(name):
 def prometheus_text(logger: MetricsLogger | None = None) -> str:
     """Prometheus text exposition of the full telemetry state: every
     counter as ``counter``, every gauge as ``gauge``, every histogram as
-    ``summary`` quantiles (+ ``_sum``/``_count``), and optionally the
-    latest point of each :class:`MetricsLogger` series."""
+    a spec-conformant ``histogram`` — cumulative ``_bucket{le="..."}``
+    series (which Prometheus CAN aggregate/quantile across replicas,
+    unlike pre-computed quantiles) plus ``_sum``/``_count`` — with the
+    human-eyes quantiles kept as a separate ``<name>_quantile`` gauge
+    family, and optionally the latest point of each
+    :class:`MetricsLogger` series."""
     lines = []
     snap = _counters.snapshot()
     gauges = {k: snap[k] for k in snap
@@ -340,11 +382,23 @@ def prometheus_text(logger: MetricsLogger | None = None) -> str:
         if not h.count:
             continue
         pn = "ptpu_" + _prom_name(k)
-        lines.append(f"# TYPE {pn} summary")
-        for q in (0.5, 0.95, 0.99):
-            lines.append(f'{pn}{{quantile="{q}"}} {h.percentile(q * 100)}')
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        with h._lock:
+            buckets = list(h._buckets)
+        for i, n in enumerate(buckets):
+            if not n:
+                continue
+            cum += n
+            _, hi = _bucket_bounds(i)
+            lines.append(f'{pn}_bucket{{le="{hi:.6g}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h.count}')
         lines.append(f"{pn}_sum {h.sum}")
         lines.append(f"{pn}_count {h.count}")
+        lines.append(f"# TYPE {pn}_quantile gauge")
+        for q in (0.5, 0.95, 0.99):
+            lines.append(
+                f'{pn}_quantile{{quantile="{q}"}} {h.percentile(q * 100)}')
     if logger is not None:
         for k in logger.names():
             pn = "ptpu_metric_" + _prom_name(k)

@@ -12,11 +12,17 @@ the full observability plane of a live trainer/engine/fleet:
   GET /traces/<trace_id>  one kept request's full span tree
   GET /flight             flight-recorder state: last postmortem bundle
                           path, bundle dir listing, event-ring tail
+  GET /alerts             health-plane alert lifecycle + admission level
+  GET /slo                per-objective multi-window burn-rate status
+  GET /signals            derived windowed signals (rates / p95s / gauges)
 
 Attach whatever the process has: ``OpsServer(fleet=...)`` aggregates
 across fleet replicas via the Router (health, merged latency
-histograms); ``OpsServer(engine=...)`` serves a standalone engine;
-``OpsServer(ledger=...)`` exposes a trainer's goodput.  ``port=0`` binds
+histograms) and serves the fleet's :class:`health.HealthMonitor`;
+``OpsServer(engine=...)`` serves a standalone engine (pass
+``monitor=HealthMonitor(...)`` to expose a hand-attached monitor);
+``OpsServer(ledger=...)`` exposes a trainer's goodput.  ``/healthz``
+degrades to ``"degraded"`` while ANY health alert fires.  ``port=0`` binds
 an ephemeral port (``server.port`` after :meth:`start`) so tests and
 bench smoke-hits never collide.  ``scripts/ops_server.py`` is the CLI.
 """
@@ -40,15 +46,23 @@ class OpsServer:
     """Serve the ops endpoints for this process; non-blocking."""
 
     def __init__(self, fleet=None, engine=None, ledger=None, logger=None,
-                 host="127.0.0.1", port=0):
+                 monitor=None, host="127.0.0.1", port=0):
         self.fleet = fleet
         self.engine = engine
         self.ledger = ledger
         self.logger = logger
+        self.monitor = monitor
         self.host = host
         self.port = int(port)
         self._srv = None
         self._thread = None
+
+    def _monitor(self):
+        """The HealthMonitor to serve: an explicit ``monitor=`` wins,
+        else the attached fleet's own."""
+        if self.monitor is not None:
+            return self.monitor
+        return getattr(self.fleet, "health", None)
 
     # -- endpoint payloads ---------------------------------------------------
     def healthz(self):
@@ -75,7 +89,35 @@ class OpsServer:
             r = self.ledger.report(publish=False)
             out["goodput"] = {"goodput": r["goodput"],
                               "accounted": r["accounted"]}
+        mon = self._monitor()
+        if mon is not None:
+            h = mon.summary()
+            out["health"] = h
+            if h["enabled"] and h["alerts"]:
+                out["status"] = "degraded"
         return 200, out
+
+    def alerts(self):
+        mon = self._monitor()
+        if mon is None:
+            return 404, {"error": "no health monitor attached"}
+        return 200, {"enabled": mon.summary()["enabled"],
+                     "admission_level": mon.admission_level(),
+                     "firing": [a.name for a in mon.firing()],
+                     "alerts": mon.alerts_state()}
+
+    def slo(self):
+        mon = self._monitor()
+        if mon is None:
+            return 404, {"error": "no health monitor attached"}
+        return 200, {"enabled": mon.summary()["enabled"],
+                     "slos": mon.slo_status()}
+
+    def signals(self):
+        mon = self._monitor()
+        if mon is None:
+            return 404, {"error": "no health monitor attached"}
+        return 200, mon.signals()
 
     def goodput(self):
         if self.ledger is None or not self.ledger.started:
@@ -126,12 +168,19 @@ class OpsServer:
             code, obj = self.trace_by_id(path[len("/traces/"):])
         elif path == "/flight":
             code, obj = self.flight_state()
+        elif path == "/alerts":
+            code, obj = self.alerts()
+        elif path == "/slo":
+            code, obj = self.slo()
+        elif path == "/signals":
+            code, obj = self.signals()
         else:
             code, obj = 404, {"error": f"unknown endpoint {path!r}",
                               "endpoints": ["/healthz", "/metrics",
                                             "/goodput", "/traces",
                                             "/traces/<trace_id>",
-                                            "/flight"]}
+                                            "/flight", "/alerts",
+                                            "/slo", "/signals"]}
         return code, "application/json", json.dumps(obj).encode()
 
     # -- server lifecycle ----------------------------------------------------

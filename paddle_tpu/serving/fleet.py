@@ -53,6 +53,7 @@ import numpy as np
 
 from ..profiler import counters
 from ..profiler import flight
+from ..profiler import health as _health
 from ..profiler import trace as rtrace
 from ..profiler.host_tracer import span
 from ..resilience import faultinject
@@ -238,6 +239,11 @@ class ServingFleet:
             self._engine_kw.update(draft_model=draft_model,
                                    spec_k=spec_k)
         self.router = router if router is not None else Router(slo_margin)
+        # the health plane: construction is free; every tick is gated on
+        # FLAGS_health inside maybe_tick().  The router shares the
+        # monitor so Router.stats()["health"] serves the same view.
+        self.health = _health.HealthMonitor(fleet=self)
+        self.router.health = self.health
         self.threaded = bool(threaded)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.max_retries = int(max_retries)
@@ -596,6 +602,7 @@ class ServingFleet:
             if not rep.hung:
                 rep.last_beat = now
         self.check_health()
+        self.health.maybe_tick()
         progressed = False
         for rep in self._alive():
             try:
@@ -630,6 +637,7 @@ class ServingFleet:
         while not self._monitor_stop.wait(tick):
             try:
                 self.check_health()
+                self.health.maybe_tick()
                 if self._pending:
                     for rep in self._candidates():
                         self._flush_pending(rep)
@@ -751,7 +759,8 @@ class ServingFleet:
                "requests": total,
                "unfinished": sum(1 for f in self._requests
                                  if not f.is_finished),
-               "closed": self._closed}
+               "closed": self._closed,
+               "health": self.health.summary()}
         paged = [st for st in reps
                  if st.get("kv_layout") == "paged" and st["alive"]]
         if paged:

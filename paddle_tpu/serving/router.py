@@ -65,6 +65,21 @@ class Router:
 
     def __init__(self, slo_margin=1.0):
         self.slo_margin = float(slo_margin)
+        # the owning ServingFleet installs its HealthMonitor here so the
+        # routing layer can expose (and later consume — ROADMAP item 3)
+        # the admission-level recommendation
+        self.health = None
+
+    def stats(self):
+        """Router-level observability: today just the health plane's
+        admission recommendation (``{"health": {..., "admission_level":
+        "ok" | "degraded" | "critical"}}``).  Recommendation only — the
+        routing policy does not act on it yet; ROADMAP item 3's
+        autoscaler is the intended consumer."""
+        if self.health is None:
+            return {"health": {"enabled": False, "admission_level": "ok",
+                               "alerts": [], "ticks": 0}}
+        return {"health": self.health.summary()}
 
     @staticmethod
     def aggregate_histograms(replicas):

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Perf-regression detector over the BENCH_r0*.json trajectory.
+
+The bench driver appends one ``BENCH_r<NN>.json`` per run (the parsed
+flagship metric plus per-leg detail under ``parsed["legs"]``).  This
+script is the pre-merge perf gate over that trajectory: it compares a
+**candidate** run (the newest file by default, or ``--candidate
+path.json``) against the **best prior** value of every (leg, metric)
+pair and exits non-zero when any metric regressed past its tolerance.
+
+Comparison model
+----------------
+* Leg dicts are flattened to dotted metric paths (``ttft.p95_ms``,
+  ``tokens_per_sec``), keeping only numeric leaves.
+* Each metric is classified by name: throughput-like (``tokens_per_sec``,
+  ``mfu``, ``capacity_ratio``, ``goodput``, hit/acceptance rates) must
+  not DROP; latency-like (``ttft``/``itl``/``queue_wait``/``*_ms``/
+  ``p50/p95/p99``/``step_time``) must not RISE.  Unclassified metrics
+  (counts, spread fractions) are informational only.
+* "Best prior" is the max (throughput) / min (latency) over every
+  earlier run that has the metric — a candidate is held to the best the
+  trajectory has ever shown, not just the previous run, so a slow decay
+  across several PRs cannot hide.
+* Tolerance is relative: candidate < best * (1 - tol) (throughput) or
+  candidate > best * (1 + tol) (latency) is a regression.  Default
+  ``--tol 0.1``; per-metric overrides with ``--tol-for ttft.p95_ms=0.25``
+  (suffix match, longest wins).
+
+Runs whose command failed (``rc != 0``) or produced nothing parseable
+are skipped (the r01 bootstrap run predates the CPU-safe bench).  Legacy
+runs without ``legs`` contribute their flagship parsed metric under the
+synthetic leg ``_flagship``.
+
+Usage::
+
+    python scripts/bench_compare.py                   # newest vs history
+    python scripts/bench_compare.py --candidate out.json --json
+    python scripts/bench_compare.py --tol 0.15 --tol-for mfu=0.05
+
+Exit status: 0 clean, 1 regression(s), 2 not enough data to compare.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_HIGHER = ("tokens_per_sec", "mfu", "capacity_ratio", "goodput",
+           "hit_rate", "acceptance", "vs_baseline")
+_LOWER_RE = re.compile(
+    r"(ttft|itl|queue_wait|latency|step_time|save|restore)"
+    r"|(_ms$)|(^|\.)(p50|p95|p99|mean)(_ms)?$")
+_SKIP_RE = re.compile(r"(^|\.)(count|spread_frac|n_params)($|\.)")
+
+
+def classify(metric):
+    """'higher' / 'lower' / None (informational) for one dotted path."""
+    if _SKIP_RE.search(metric):
+        return None
+    if any(tok in metric for tok in _HIGHER):
+        return "higher"
+    if _LOWER_RE.search(metric):
+        return "lower"
+    return None
+
+
+def _flatten(obj, prefix=""):
+    out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_flatten(v, f"{prefix}{k}." if prefix or True
+                                else k))
+    elif isinstance(obj, bool):
+        pass
+    elif isinstance(obj, (int, float)):
+        out[prefix[:-1]] = float(obj)
+    return out
+
+
+def extract(run):
+    """``{leg: {metric: value}}`` from one BENCH json dict (None when
+    the run carries nothing comparable)."""
+    if run.get("rc") not in (0, None):
+        return None
+    parsed = run.get("parsed")
+    if not isinstance(parsed, dict):
+        return None
+    legs = parsed.get("legs")
+    out = {}
+    if isinstance(legs, dict):
+        for leg, detail in legs.items():
+            if isinstance(detail, dict):
+                out[leg] = _flatten(detail)
+    else:
+        # legacy flagship-only run: "gpt125m_train_tokens_per_sec_per_chip"
+        # becomes leg "gpt125m" metric "tokens_per_sec" (vs_baseline is
+        # the MFU fraction on the train legs) so the trajectory stays
+        # comparable across the schema change
+        name = str(parsed.get("metric", ""))
+        m = re.match(r"([A-Za-z0-9]+)_train_tokens_per_sec", name)
+        leg = m.group(1) if m else "_flagship"
+        flat = {}
+        if isinstance(parsed.get("value"), (int, float)):
+            flat["tokens_per_sec"] = float(parsed["value"])
+        if isinstance(parsed.get("vs_baseline"), (int, float)):
+            flat["mfu" if m else "vs_baseline"] = \
+                float(parsed["vs_baseline"])
+        if flat:
+            out[leg] = flat
+    return out or None
+
+
+def load_history(pattern):
+    runs = []
+    for path in sorted(glob.glob(pattern)):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        legs = extract(d)
+        if legs is not None:
+            runs.append({"path": path, "n": d.get("n"), "legs": legs})
+    return runs
+
+
+def tol_for(metric, default, overrides):
+    """Longest-suffix-match tolerance override for one metric path."""
+    best_len, best = -1, default
+    for suffix, t in overrides.items():
+        if (metric == suffix or metric.endswith("." + suffix)
+                or metric.endswith(suffix)) and len(suffix) > best_len:
+            best_len, best = len(suffix), t
+    return best
+
+
+def compare(history, candidate, default_tol, overrides):
+    """Candidate legs vs best prior per (leg, metric).  Returns
+    (regressions, checks) — ``checks`` is every comparison made."""
+    best = {}           # (leg, metric) -> (value, path)
+    for run in history:
+        for leg, metrics in run["legs"].items():
+            for m, v in metrics.items():
+                direction = classify(m)
+                if direction is None:
+                    continue
+                key = (leg, m)
+                cur = best.get(key)
+                better = (cur is None
+                          or (direction == "higher" and v > cur[0])
+                          or (direction == "lower" and v < cur[0]))
+                if better:
+                    best[key] = (v, run["path"])
+    checks, regressions = [], []
+    for leg, metrics in candidate["legs"].items():
+        for m, v in sorted(metrics.items()):
+            direction = classify(m)
+            if direction is None or (leg, m) not in best:
+                continue
+            bv, bpath = best[(leg, m)]
+            tol = tol_for(m, default_tol, overrides)
+            if direction == "higher":
+                limit = bv * (1.0 - tol)
+                bad = v < limit
+            else:
+                limit = bv * (1.0 + tol)
+                bad = v > limit
+            rec = {"leg": leg, "metric": m, "direction": direction,
+                   "candidate": v, "best_prior": bv,
+                   "best_prior_run": os.path.basename(bpath),
+                   "tolerance": tol, "limit": limit,
+                   "regressed": bad}
+            checks.append(rec)
+            if bad:
+                regressions.append(rec)
+    return regressions, checks
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="BENCH trajectory perf-regression gate")
+    ap.add_argument("--glob", default="BENCH_r0*.json",
+                    help="history file pattern (default: BENCH_r0*.json "
+                         "in the repo root / cwd)")
+    ap.add_argument("--candidate", default=None,
+                    help="candidate run json (default: the newest "
+                         "history file; it is then excluded from the "
+                         "prior set)")
+    ap.add_argument("--tol", type=float, default=0.1,
+                    help="default relative tolerance (default 0.1)")
+    ap.add_argument("--tol-for", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="per-metric tolerance override (suffix match), "
+                         "repeatable")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    overrides = {}
+    for spec in args.tol_for:
+        name, _, frac = spec.partition("=")
+        try:
+            overrides[name] = float(frac)
+        except ValueError:
+            ap.error(f"bad --tol-for {spec!r} (want METRIC=FRAC)")
+
+    history = load_history(args.glob)
+    if args.candidate:
+        try:
+            with open(args.candidate) as f:
+                d = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench_compare: cannot read candidate "
+                  f"{args.candidate}: {e}", file=sys.stderr)
+            return 2
+        legs = extract(d)
+        if legs is None:
+            print("bench_compare: candidate run has no comparable "
+                  "metrics", file=sys.stderr)
+            return 2
+        candidate = {"path": args.candidate, "n": d.get("n"),
+                     "legs": legs}
+        prior = [r for r in history
+                 if os.path.abspath(r["path"])
+                 != os.path.abspath(args.candidate)]
+    else:
+        if len(history) < 2:
+            print("bench_compare: need >= 2 comparable runs "
+                  f"(found {len(history)} under {args.glob!r})",
+                  file=sys.stderr)
+            return 2
+        candidate, prior = history[-1], history[:-1]
+
+    if not prior:
+        print("bench_compare: no prior runs to compare against",
+              file=sys.stderr)
+        return 2
+
+    regressions, checks = compare(prior, candidate, args.tol, overrides)
+    report = {"candidate": os.path.basename(candidate["path"]),
+              "prior_runs": [os.path.basename(r["path"]) for r in prior],
+              "checks": checks,
+              "regressions": regressions,
+              "value": len(regressions)}
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"candidate {report['candidate']} vs "
+              f"{len(prior)} prior run(s):")
+        for c in checks:
+            mark = "REGRESSED" if c["regressed"] else "ok"
+            arrow = ">" if c["direction"] == "higher" else "<"
+            print(f"  [{mark:>9}] {c['leg']}.{c['metric']}: "
+                  f"{c['candidate']:g} (best {c['best_prior']:g} in "
+                  f"{c['best_prior_run']}, need {arrow}= "
+                  f"{c['limit']:g})")
+        if not checks:
+            print("  (no overlapping gated metrics)")
+        print(f"{len(regressions)} regression(s)")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -857,6 +857,175 @@ def run():
                                                             "[0.2, 1.3]")
     rtrace.clear()
 
+    # ---- health gate: the health plane is zero-overhead OFF (no
+    # health.* movement, counter-identical parity keys vs the ON run of
+    # the same fresh train + slot/paged/fleet workload), fires ZERO
+    # alerts on clean ON legs, fires EXACTLY the expected alert under
+    # injected chaos (slow_decode -> itl_burn, kv_pool_exhausted ->
+    # kv_backpressure) with a postmortem dump naming the rule + window,
+    # and the admission recommendation reaches Router.stats() plus the
+    # live /alerts /slo /signals endpoints.
+    import urllib.request
+
+    from paddle_tpu.profiler import flight as pflight
+    from paddle_tpu.profiler import health as phealth
+    from paddle_tpu.profiler.ops import OpsServer
+
+    def health_workloads():
+        """Fresh train step + slot/paged engines (standalone monitor,
+        first tick post-warm) + a sync fleet (self-ticking from pump);
+        returns the measured counter delta."""
+        paddle.seed(0)
+        rngh = np.random.RandomState(13)
+        hm = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+        hopt = paddle.optimizer.AdamW(1e-3, parameters=hm.parameters())
+        hstep = pjit.CompiledTrainStep(hm, loss_fn, hopt)
+        e5 = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4)
+        p5 = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4,
+                       kv_layout="paged", block_size=4, prefill_chunk=8)
+        mon = phealth.HealthMonitor(interval_s=0.0).attach(e5).attach(p5)
+
+        def sv(e_, lens, tick=False):
+            hs = [e_.add_request(rngh.randint(0, 64, size=n).tolist(),
+                                 max_new_tokens=3) for n in lens]
+            while not all(h.is_finished for h in hs):
+                e_.step()
+                if tick:    # live monitoring mid-serve must be free
+                    mon.maybe_tick()
+            return hs
+
+        for _ in range(WARMUP):
+            hstep(x, y).numpy()
+        sv(e5, SERVE_LENS_WARM)
+        sv(p5, SERVE_LENS_WARM)
+        fl5 = ServingFleet(smodel, replicas=2, max_slots=2, max_seq_len=32,
+                           min_bucket=4, threaded=False,
+                           warm_buckets=SERVE_LENS_WARM)
+        b = counters.snapshot()
+        for _ in range(MEASURE):
+            hstep(x, y).numpy()
+        mon.maybe_tick()
+        sv(e5, SERVE_LENS_MEASURE, tick=True)
+        sv(p5, SERVE_LENS_MEASURE, tick=True)
+        fhs = [fl5.submit(rngh.randint(0, 64, size=n).tolist(),
+                          max_new_tokens=3) for n in SERVE_LENS_MEASURE]
+        fl5.join(fhs)
+        d = counters.delta(b)
+        fl5.drain()
+        return d
+
+    pflags.set_flags({"FLAGS_health": False})
+    hoff = health_workloads()
+    hoff_moved = {k: v for k, v in hoff.items()
+                  if k.startswith("health.") and v}
+    if hoff_moved:
+        violations["health-off:counters"] = (hoff_moved, {})
+    pflags.set_flags({"FLAGS_health": True, "FLAGS_health_interval_s": 0.0})
+    try:
+        hon = health_workloads()
+        for k in PARITY_KEYS:
+            if hon.get(k, 0) != hoff.get(k, 0):
+                violations[f"health-parity:{k}"] = (hon.get(k, 0),
+                                                    hoff.get(k, 0))
+        hclean_fired = {k: v for k, v in hon.items()
+                        if k.startswith("health.alerts.fired") and v}
+        if hclean_fired:
+            violations["health-clean:alerts"] = (hclean_fired, {})
+        if not hon.get("health.ticks"):
+            violations["health-on:ticks"] = (hon.get("health.ticks", 0),
+                                             ">=1")
+
+        # chaos leg 1: a stalled decode loop must trip the fast+slow ITL
+        # burn windows of the fleet's own monitor — and nothing else
+        rngh6 = np.random.RandomState(17)
+        fl6 = ServingFleet(smodel, replicas=2, max_slots=2, max_seq_len=32,
+                           min_bucket=4, threaded=False,
+                           warm_buckets=SERVE_LENS_WARM,
+                           heartbeat_timeout_s=30.0)
+        b = counters.snapshot()
+        chs6 = [fl6.submit(rngh6.randint(0, 64, size=3).tolist(),
+                           max_new_tokens=6) for _ in range(4)]
+        fl6.join(chs6)       # clean leg on the same fleet: silence
+        hclean6 = {k: v for k, v in counters.delta(b).items()
+                   if k.startswith("health.alerts.fired.") and v}
+        if hclean6:
+            violations["health-chaos:clean-leg"] = (hclean6, {})
+        chs6 = [fl6.submit(rngh6.randint(0, 64, size=3).tolist(),
+                           max_new_tokens=8) for _ in range(4)]
+        with faultinject.fault_schedule(f"slow_decode@{chs6[0].rid}*8"):
+            fl6.join(chs6)
+        hfired = {k: v for k, v in counters.delta(b).items()
+                  if k.startswith("health.alerts.fired.")}
+        if hfired != {"health.alerts.fired.itl_burn": 1}:
+            violations["health-chaos:slow_decode"] = (
+                hfired, {"health.alerts.fired.itl_burn": 1})
+        hb = pflight.load(pflight.last_dump_path())
+        hdump = (hb.get("reason"),
+                 (hb.get("context") or {}).get("rule"),
+                 bool(((hb.get("context") or {}).get("window") or {})
+                      .get("seconds")))
+        if hdump != ("health_itl_burn", "itl_burn", True):
+            violations["health-chaos:slow_decode-dump"] = (
+                hdump, ("health_itl_burn", "itl_burn", True))
+        # the recommendation must reach the router and the live ops
+        # endpoints while the alert is still firing
+        hadm = fl6.router.stats()["health"]["admission_level"]
+        if hadm != "critical":
+            violations["health-chaos:admission"] = (hadm, "critical")
+        ops_live = {}
+        with OpsServer(fleet=fl6) as srv:
+            for ep in ("/alerts", "/slo", "/signals", "/healthz"):
+                body = json.loads(urllib.request.urlopen(
+                    srv.url(ep), timeout=10).read())
+                ops_live[ep] = sorted(body)[:4]
+                if ep == "/alerts" and body.get("firing") != ["itl_burn"]:
+                    violations["health-ops:alerts"] = (body.get("firing"),
+                                                       ["itl_burn"])
+                if ep == "/healthz" and body.get("status") != "degraded":
+                    violations["health-ops:healthz"] = (body.get("status"),
+                                                        "degraded")
+        fl6.drain()
+
+        # chaos leg 2: refused block reservations must trip the KV
+        # backpressure watchdog on a standalone paged engine (first tick
+        # after warmup so compile activity stays outside every window)
+        p6 = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4,
+                       kv_layout="paged", block_size=4, prefill_chunk=8)
+        mon6 = phealth.HealthMonitor(
+            rules=[wd for wd in phealth.default_watchdogs()
+                   if wd.name in ("kv_backpressure", "kv_conservation")],
+            interval_s=0.0).attach(p6)
+        h0 = p6.add_request(rngh6.randint(0, 64, size=6).tolist(),
+                            max_new_tokens=3)
+        while not h0.is_finished:
+            p6.step()
+        mon6.maybe_tick()
+        b = counters.snapshot()
+        h1 = p6.add_request(rngh6.randint(0, 64, size=6).tolist(),
+                            max_new_tokens=3)
+        with faultinject.fault_schedule(f"kv_pool_exhausted@{h1.rid}"):
+            for _ in range(300):
+                p6.step()
+                mon6.maybe_tick()
+                if h1.is_finished:
+                    break
+        kfired = {k: v for k, v in counters.delta(b).items()
+                  if k.startswith("health.alerts.fired.")}
+        if kfired != {"health.alerts.fired.kv_backpressure": 1}:
+            violations["health-chaos:kv_pool_exhausted"] = (
+                kfired, {"health.alerts.fired.kv_backpressure": 1})
+        kb = pflight.load(pflight.last_dump_path())
+        kwin = (kb.get("context") or {}).get("window") or {}
+        kdump = (kb.get("reason"),
+                 (kwin.get("delta") or {}).get("serving.kv.pool_exhausted",
+                                               0) >= 1)
+        if kdump != ("health_kv_backpressure", True):
+            violations["health-chaos:kv-dump"] = (
+                kdump, ("health_kv_backpressure", True))
+    finally:
+        pflags.set_flags({"FLAGS_health": False,
+                          "FLAGS_health_interval_s": 1.0})
+
     # ---- program-audit gate: FLAGS_program_audit=enforce holds over the
     # whole compiled-program surface (train single/fused/mesh-dp2 +
     # slot/paged serving incl. the COW copy program) with zero findings,
@@ -1029,6 +1198,16 @@ def run():
                                "off_trace_moved": off_moved,
                                "on_finished": ton.get("trace.finished", 0)},
               "trace_span_ratios": trace_ratios,
+              "health_parity": {"off": _pick(hoff), "on": _pick(hon),
+                                "off_health_moved": hoff_moved,
+                                "on_ticks": hon.get("health.ticks", 0),
+                                "clean_fired": hclean_fired},
+              "health_chaos": {"slow_decode_fired": hfired,
+                               "slow_decode_dump": list(hdump),
+                               "kv_fired": kfired,
+                               "kv_dump": list(kdump),
+                               "admission_level": hadm,
+                               "ops": ops_live},
               "program_audit": {"off": audit_off, "on": audit_on,
                                 "audits": audits_run,
                                 "findings": audit_delta.get(

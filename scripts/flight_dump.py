@@ -8,9 +8,10 @@ Usage::
                                                      # default dump dir
 
 Renders the bundle sections written by ``paddle_tpu.profiler.flight.dump``
-— reason/context header, active span stack, the counters that MOVED since
-startup (full snapshot stays in the JSON), histogram percentiles, and the
-event ring tail with relative timestamps.  ``--events N`` bounds the tail
+— reason/context header, active span stack, the health plane's alert set
+and last window (when FLAGS_health was on at dump time), the counters
+that MOVED since startup (full snapshot stays in the JSON), histogram
+percentiles, and the event ring tail with relative timestamps.  ``--events N`` bounds the tail
 (default 40; 0 = all); ``--raw`` re-emits the bundle as indented JSON.
 """
 
@@ -93,6 +94,27 @@ def render(path, max_events=40, raw=False, out=sys.stdout):
         w(f"\n-- request span trees ({len(span_trees)}):\n")
         for t in span_trees:
             _render_span_tree(t, w)
+
+    health = bundle.get("health")
+    if health:
+        alerts = health.get("alerts") or []
+        w(f"\n-- alerts (admission={health.get('admission_level')}, "
+          f"{sum(1 for a in alerts if a.get('state') == 'firing')} "
+          f"firing of {len(alerts)}):\n")
+        for a in alerts:
+            detail = " ".join(f"{k}={_fmt_val(v)}"
+                              for k, v in (a.get("detail") or {}).items())
+            w(f"  [{a.get('state'):<8}] {a.get('name'):<20} "
+              f"{a.get('kind')}/{a.get('severity')}"
+              + (f"  {detail}" if detail else "") + "\n")
+        win = health.get("window")
+        if win:
+            w(f"  window   : {win.get('seconds', 0):.3f}s "
+              f"(ticks {win.get('start_tick')}..{win.get('end_tick')})\n")
+            for k in sorted(win.get("delta") or {}):
+                w(f"    {k:<40} +{_fmt_val(win['delta'][k])}\n")
+            for k in sorted(win.get("p95") or {}):
+                w(f"    {k:<40} p95 {_fmt_val(win['p95'][k])}\n")
 
     moved = {k: v for k, v in (bundle.get("counters_delta") or {}).items()
              if v}

@@ -183,16 +183,26 @@ class TestMetricsLogger:
     def test_prometheus_text_exposition(self):
         counters.inc("test.prom.counter", 3)
         metrics.observe("test.prom.hist_ns", 1e6, unit="ns")
+        metrics.observe("test.prom.hist_ns", 3e6, unit="ns")
         log = MetricsLogger()
         log.log(step=5, loss=1.25)
         text = metrics.prometheus_text(log)
         assert "# TYPE ptpu_test_prom_counter counter" in text
         assert "ptpu_test_prom_counter 3" in text
-        assert "# TYPE ptpu_test_prom_hist_ns summary" in text
-        assert 'ptpu_test_prom_hist_ns{quantile="0.5"}' in text
-        assert "ptpu_test_prom_hist_ns_count 1" in text
+        # spec-conformant histogram: cumulative le-buckets + sum/count
+        # (aggregatable across replicas), quantiles as a gauge family
+        assert "# TYPE ptpu_test_prom_hist_ns histogram" in text
+        assert 'ptpu_test_prom_hist_ns_bucket{le="+Inf"} 2' in text
+        assert "ptpu_test_prom_hist_ns_sum 4000000.0" in text
+        assert "ptpu_test_prom_hist_ns_count 2" in text
+        assert "# TYPE ptpu_test_prom_hist_ns_quantile gauge" in text
+        assert 'ptpu_test_prom_hist_ns_quantile{quantile="0.5"}' in text
         assert "# TYPE ptpu_metric_loss gauge" in text
         assert "ptpu_metric_loss 1.25" in text
+        # cumulative bucket counts are monotone and end at the count
+        cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+                if line.startswith("ptpu_test_prom_hist_ns_bucket")]
+        assert cums == sorted(cums) and cums[-1] == 2
 
 
 class TestConcurrency:
