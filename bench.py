@@ -68,11 +68,12 @@ the fleet leg additionally smoke-hits the live ops endpoint (OpsServer
 ckpt leg embeds save-latency percentiles; the mesh legs embed
 per-compiled-program HBM bytes ("hbm") captured via XLA memory analysis
 under FLAGS_device_telemetry.
-Set PTPU_BENCH=125m|760m|serve|paged|paged_q|spec|ckpt|fleet|mesh|mesh760m to run a
-single leg.  PTPU_FUSED_STEPS sets the fused window length K (default 4; 1
+Set PTPU_BENCH=125m|760m|serve|paged|paged_q|spec|ckpt|fleet|disagg|mesh|mesh760m
+to run a single leg.  PTPU_FUSED_STEPS sets the fused window length K (default 4; 1
 disables the fused leg).  PTPU_MESH picks the mesh leg's axis degrees.
 """
 
+import itertools
 import json
 import os
 import time
@@ -969,6 +970,203 @@ def _run_fleet_leg(cfg, replicas=2, n_requests=8, max_new=32, max_slots=4,
     return leg
 
 
+def _run_disagg_leg(cfg, n_long=6, n_short=18, max_new=16, max_slots=None,
+                    min_bucket=8, block_size=8, prefill_chunk=16,
+                    min_speedup=1.3, seed=0):
+    """Disaggregated prefill/decode leg: the same mixed long/short
+    request set through a 2-replica unified paged fleet and a 1+1
+    prefill/decode split at EQUAL replica count.  On the split, every
+    prompt prefills on the prefill replica and hands its KV to the
+    decode replica by block-granular migration, so long-prompt prefill
+    chunks stop interleaving with the decode iterations of streams
+    already emitting tokens — the classic interference that owns the
+    unified fleet's p95 inter-token latency under mixed traffic.
+
+    Gates: disagg p95 ITL beats unified by >= ``min_speedup`` (the
+    headline number), disagg output token-identical to unified, every
+    request migrated exactly once, zero steady retraces on BOTH roles in
+    BOTH modes (the one-decode-program economics survive the split), and
+    a churn pass with a migration severed mid-flight (``kv_migrate_drop``)
+    plus a replica killed mid-stream: zero lost requests, output
+    token-identical to the clean disagg pass."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.profiler import counters, metrics
+    from paddle_tpu.resilience import faultinject
+    from paddle_tpu.serving import ServingFleet
+
+    paddle.seed(seed)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(seed)
+    S = cfg.max_seq_len
+    long_lens = [int(rng.randint(int(S * 0.7), S - max_new))
+                 for _ in range(n_long)]
+    short_lens = [int(rng.randint(4, max(5, S // 8)))
+                  for _ in range(n_short)]
+    # interleave so short streams are mid-decode while long prefills
+    # arrive — the interference the split is supposed to remove
+    lens = []
+    si = iter(short_lens)
+    ratio = max(1, n_short // n_long)
+    for n in long_lens:
+        lens.extend(itertools.islice(si, ratio))
+        lens.append(n)
+    lens.extend(si)
+    prompts = [rng.randint(0, cfg.vocab_size, size=n).tolist()
+               for n in lens]
+    # the warm pass runs DISJOINT prompts of the same lengths: it
+    # compiles every program (prefill buckets, decode, the migration
+    # gather) without seeding the prefix trees with the measured
+    # prompts — a warm-pass prefix hit would erase the very prefill
+    # work whose interference this leg measures
+    warm_prompts = [rng.randint(0, cfg.vocab_size, size=n).tolist()
+                    for n in lens]
+    seeds = list(range(100, 100 + len(prompts)))
+    if max_slots is None:
+        # slots cover the whole burst on every replica: the comparison
+        # isolates prefill/decode interference, not slot queueing (the
+        # decode side of the split hosts ALL streams at once)
+        max_slots = len(prompts)
+
+    def build(prefill_replicas):
+        # threaded: each replica gets its own scheduler thread, so the
+        # split actually removes interference — a single shared loop
+        # would serialize prefill chunks with decode steps regardless
+        # of role assignment
+        return ServingFleet(
+            model, replicas=2, prefill_replicas=prefill_replicas,
+            max_slots=max_slots, max_seq_len=S, min_bucket=min_bucket,
+            threaded=True, kv_layout="paged", block_size=block_size,
+            n_blocks=max(128, 4 * S // block_size * max_slots),
+            prefill_chunk=prefill_chunk, warm_buckets=lens,
+            max_retries=2)
+
+    def run_pass(fleet, schedule=None, which=None):
+        before = counters.snapshot()
+        t0 = time.perf_counter()
+        hs = [fleet.submit(p, max_new_tokens=max_new, seed=s)
+              for p, s in zip(which if which is not None else prompts,
+                              seeds)]
+        if schedule:
+            with faultinject.fault_schedule(schedule):
+                fleet.join(hs)
+        else:
+            fleet.join(hs)
+        dt = time.perf_counter() - t0
+        return hs, dt, counters.delta(before)
+
+    def measure(prefill_replicas, schedule=None, rounds=1):
+        fleet = build(prefill_replicas)
+        # warm pass (disjoint prompts): compiles the migrate program too
+        run_pass(fleet, which=warm_prompts)
+        # fresh per-engine histograms so the fleet percentiles below see
+        # ONLY the measured rounds (warmup + warm-pass latency excluded)
+        for rep in fleet._replicas:
+            rep.engine.hists = {
+                n: metrics.Histogram(n, h.unit)
+                for n, h in rep.engine.hists.items()}
+        before = counters.snapshot()
+        hs = d1 = None
+        total_s = 0.0
+        for r in range(rounds):
+            if r:
+                # later rounds stay prefill-cold: drop the prefix blocks
+                # the previous round donated, or every repeat would be a
+                # prefix hit and skip the very work being measured
+                for rep in fleet._replicas:
+                    if rep.engine.prefix is not None:
+                        rep.engine.prefix.clear()
+            rhs, dt, d = run_pass(fleet, schedule=schedule)
+            total_s += dt
+            if hs is None:
+                hs, d1 = rhs, d
+            elif any(a.tokens != b.tokens for a, b in zip(rhs, hs)):
+                raise AssertionError(
+                    "disagg leg: identical seeds diverged across "
+                    "measured rounds")
+        d = counters.delta(before)
+        # block economics come from the cold first round; retrace /
+        # loss / migration-count gates cover every round
+        d["serving.fleet.migrate.blocks_copied"] = d1.get(
+            "serving.fleet.migrate.blocks_copied", 0)
+        d["serving.fleet.migrate.blocks_shared"] = d1.get(
+            "serving.fleet.migrate.blocks_shared", 0)
+        agg = fleet.router.aggregate_histograms(fleet._replicas)
+        roles = fleet.stats()["roles"]
+        fleet.drain()
+        return hs, total_s, d, agg, roles
+
+    rounds = 3
+    uni_hs, uni_s, uni_d, uni_agg, _ = measure(0, rounds=rounds)
+    dis_hs, dis_s, dis_d, dis_agg, roles = measure(1, rounds=rounds)
+    match = all(u.finish_reason == "length" and v.finish_reason == "length"
+                and u.tokens == v.tokens
+                for u, v in zip(uni_hs, dis_hs))
+    # churn: one migration severed between export and adopt plus one
+    # replica crash while hand-offs are in flight — replay must deliver
+    # the identical streams with nothing lost
+    # rids count per-fleet: the churn fleet's warm pass consumes
+    # 0..len-1, so the measured pass starts at rid == len(prompts)
+    churn_hs, _, churn_d, _, _ = measure(
+        1, schedule=(f"kv_migrate_drop@{len(prompts)}"
+                     f",replica_crash@{len(prompts) + 1}"))
+    churn_match = all(v.finish_reason == "length" and c.tokens == v.tokens
+                      for c, v in zip(churn_hs, dis_hs))
+    uni_itl = _latency_ms(uni_agg["serving.itl_ns"])
+    dis_itl = _latency_ms(dis_agg["serving.itl_ns"])
+    speedup = uni_itl["p95_ms"] / max(dis_itl["p95_ms"], 1e-9)
+    decode_tokens = len(prompts) * max_new * rounds
+    leg = {"replicas": 2,
+           "roles": roles,
+           "requests": len(prompts),
+           "measured_rounds": rounds,
+           "long_prompts": n_long,
+           "max_new_tokens": max_new,
+           "unified_itl": uni_itl,
+           "disagg_itl": dis_itl,
+           "itl_p95_speedup": round(speedup, 4),
+           "unified_ttft": _latency_ms(uni_agg["serving.ttft_ns"]),
+           "disagg_ttft": _latency_ms(dis_agg["serving.ttft_ns"]),
+           "unified_decode_tokens_per_sec":
+               round(decode_tokens / max(uni_s, 1e-9), 2),
+           "disagg_decode_tokens_per_sec":
+               round(decode_tokens / max(dis_s, 1e-9), 2),
+           "migrated": dis_d.get("serving.fleet.migrate.requests", 0),
+           "blocks_copied":
+               dis_d.get("serving.fleet.migrate.blocks_copied", 0),
+           "blocks_shared":
+               dis_d.get("serving.fleet.migrate.blocks_shared", 0),
+           "migrate_deferred":
+               dis_d.get("serving.fleet.migrate.deferred", 0),
+           "steady_retraces_unified": uni_d.get("serving.retraces", 0),
+           "steady_retraces_disagg": dis_d.get("serving.retraces", 0),
+           "outputs_match_unified": match,
+           "churn": {
+               "dropped": churn_d.get("serving.fleet.migrate.dropped", 0),
+               "deaths": churn_d.get("serving.fleet.replica_deaths", 0),
+               "retried": churn_d.get("serving.fleet.retried", 0),
+               "lost": churn_d.get("serving.fleet.lost", 0),
+               "outputs_match_clean": churn_match}}
+    if (not match or leg["migrated"] != len(prompts) * rounds
+            or leg["steady_retraces_unified"] != 0
+            or leg["steady_retraces_disagg"] != 0
+            or uni_d.get("serving.fleet.lost", 0) != 0
+            or dis_d.get("serving.fleet.lost", 0) != 0):
+        raise AssertionError(
+            f"disagg leg broke the migration invariants: {leg}")
+    if (not churn_match or leg["churn"]["lost"] != 0
+            or leg["churn"]["dropped"] < 1 or leg["churn"]["deaths"] < 1):
+        raise AssertionError(
+            f"disagg leg churn pass broke durability: {leg}")
+    if speedup < min_speedup:
+        raise AssertionError(
+            f"disagg p95 ITL speedup {speedup:.3f}x below the "
+            f"{min_speedup:.2f}x floor: {leg}")
+    del model
+    return leg
+
+
 def _parse_mesh_degrees(spec):
     """Parse a ``PTPU_MESH`` string like ``dp2``, ``dp4`` or ``dp2mp2``
     into an ordered ``{axis_name: degree}`` dict."""
@@ -1208,6 +1406,12 @@ def main():
         out["fleet"] = _run_fleet_leg(cfg, replicas=2, n_requests=4,
                                       max_new=8, max_slots=2,
                                       min_bucket=4)
+        # tiny disaggregated leg: prefill/decode split vs unified at
+        # equal replica count — p95 ITL win (>=1.3x), migration block
+        # accounting, token identity and churn durability gates always
+        out["disagg"] = _run_disagg_leg(cfg, n_long=4, n_short=12,
+                                        max_new=32, min_bucket=4,
+                                        block_size=8, prefill_chunk=16)
         # tiny mesh leg: steady-state counter gates on the multi-chip
         # SPMD path always; scaling efficiency is informational on
         # forced-host CPU "devices" (they share the same cores)
@@ -1221,11 +1425,12 @@ def main():
 
     which = os.environ.get("PTPU_BENCH", "all")
     if which not in ("all", "760m", "125m", "serve", "paged", "paged_q",
-                     "spec", "ckpt", "fleet", "mesh", "mesh760m"):
+                     "spec", "ckpt", "fleet", "disagg", "mesh",
+                     "mesh760m"):
         raise SystemExit(
             f"PTPU_BENCH={which!r}: expected "
-            f"all|760m|125m|serve|paged|paged_q|spec|ckpt|fleet|mesh|"
-            f"mesh760m")
+            f"all|760m|125m|serve|paged|paged_q|spec|ckpt|fleet|disagg|"
+            f"mesh|mesh760m")
     mesh_degrees = _parse_mesh_degrees(os.environ.get("PTPU_MESH", "dp2"))
     mesh_ndev = int(np.prod(list(mesh_degrees.values())))
     legs = {}
@@ -1335,6 +1540,19 @@ def main():
         legs["gpt125m_fleet"] = _run_fleet_leg(fcfg, replicas=2,
                                                n_requests=8, max_new=64,
                                                max_slots=4)
+    if which in ("all", "disagg"):
+        # disaggregated prefill/decode leg: 1+1 split vs 2-replica
+        # unified on mixed long/short traffic (acceptance: >=1.3x p95
+        # ITL win at equal replica count, zero lost under migration
+        # chaos, token identity to the unified fleet)
+        dcfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
+                                   dtype="bfloat16",
+                                   use_flash_attention=False,
+                                   recompute=None)
+        legs["gpt125m_disagg"] = _run_disagg_leg(dcfg, n_long=6,
+                                                 n_short=18, max_new=64,
+                                                 block_size=16,
+                                                 prefill_chunk=256)
     if which == "mesh" or (which == "all"
                            and jax.device_count() >= mesh_ndev):
         # multi-chip SPMD leg: weak-scaled fused training on the
@@ -1379,6 +1597,16 @@ def main():
             "value": leg["decode_tokens_per_sec"],
             "unit": "tokens/s",
             "vs_baseline": leg["churn_retention"],  # vs one replica killed
+            "legs": legs,
+        }))
+        return
+    if set(legs) == {"gpt125m_disagg"}:  # disagg-only: ITL-win line
+        leg = legs["gpt125m_disagg"]
+        print(json.dumps({
+            "metric": "gpt125m_disagg_itl_p95_speedup",
+            "value": leg["itl_p95_speedup"],
+            "unit": "x unified p95 ITL at equal replica count",
+            "vs_baseline": leg["disagg_itl"]["p95_ms"],
             "legs": legs,
         }))
         return

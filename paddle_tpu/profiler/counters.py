@@ -43,6 +43,29 @@ Well-known names (see README "Observability" for the full table):
   serving.fleet.prefix_routed (dispatches won by prefix-cache affinity)
   serving.fleet.lost (admitted request without terminal state; MUST be 0)
   serving.fleet.replicas / serving.fleet.decode_tps (gauges)
+  serving.fleet.health_shed (admissions refused because the health
+      plane's admission level is critical; also counted under .shed)
+  serving.fleet.migrate.requests (prefill→decode KV hand-offs completed)
+  serving.fleet.migrate.blocks_copied (blocks device-copied by
+      migrations: owned, non-prefix-shared blocks ONLY)
+  serving.fleet.migrate.blocks_shared (blocks adopted from the
+      destination's radix tree by refcount transfer — never copied)
+  serving.fleet.migrate.tokens (KV tokens handed off)
+  serving.fleet.migrate.deferred (hand-offs parked on decode-side
+      backpressure; the request stays held on its source, KV intact,
+      and the migration retries next scheduler tick)
+  serving.fleet.migrate.dropped (migrations severed by the
+      kv_migrate_drop fault site; request replays, nothing lost)
+  serving.fleet.migrate.failed (migrations aborted: no decode capacity
+      or destination pool exhausted; request replays)
+  serving.autoscale.decisions[.<action>] (autoscaler actions taken:
+      disaggregate / grow_prefill / grow_decode / retire)
+  serving.autoscale.flips.to_prefill / serving.autoscale.flips.to_decode
+      (replica role changes, by direction)
+  serving.autoscale.spawns / serving.autoscale.retires (fleet-size
+      changes the autoscaler made)
+  serving.autoscale.prefill_replicas / serving.autoscale.decode_replicas
+      (gauges: the live role split; both 0 in a unified fleet)
   serving.kv.prefix_hits / serving.kv.prefix_misses /
   serving.kv.prefix_hit_tokens (paged radix prefix-cache outcomes)
   serving.kv.cow_copies (copy-on-write partial-block adoptions)

@@ -4,8 +4,10 @@ live invariant watchdogs.
 PRs 8/10 built the raw telemetry plane (counters/gauges, mergeable
 histograms, request traces, goodput ledger, flight recorder, ops HTTP
 endpoint); nothing in the running process *interpreted* any of it.  This
-module is the derived-signals layer the ROADMAP item-3 autoscaler will
-consume:
+module is the derived-signals layer the ROADMAP item-3 consumers act on
+(``serving.autoscale.FleetAutoscaler`` rebalances the prefill:decode
+split on these burn rates; ``Router.pick`` tightens/refuses admission on
+``admission_level``):
 
 * :class:`HealthMonitor` — takes periodic immutable :class:`Snapshot`\\ s
   of the whole counter/gauge/histogram registry into a bounded ring and
@@ -29,8 +31,10 @@ consume:
   window on every 0->1 transition, and fold into a single
   ``admission_level`` recommendation (``ok`` / ``degraded`` /
   ``critical``) that ``ServingFleet.stats()["health"]`` and
-  ``Router.stats()["health"]`` expose.  Recommendation only — nothing in
-  this module takes a scaling or shedding action.
+  ``Router.stats()["health"]`` expose.  Nothing in THIS module takes a
+  scaling or shedding action — the consumers do: the Router sheds on the
+  admission level and the FleetAutoscaler flips/grows replica roles on
+  the burn-rate alerts.
 
 Wiring: ``ServingFleet`` owns a monitor and ticks it from its heartbeat
 thread (or from every :meth:`pump` in sync mode); any other process
@@ -538,6 +542,17 @@ class HealthMonitor:
         with self._lock:
             return [a for a in self._alerts.values()
                     if a.state == "firing"]
+
+    def firing_names(self):
+        """Set of currently-firing rule names — the autoscaler's decision
+        predicate reads this instead of re-walking Alert objects."""
+        return {a.name for a in self.firing()}
+
+    def alert_firing(self, name) -> bool:
+        """True while the named rule's alert is in the firing state."""
+        with self._lock:
+            a = self._alerts.get(name)
+            return a is not None and a.state == "firing"
 
     def alerts_state(self):
         """JSON-safe list of every alert ever raised, firing first."""

@@ -58,6 +58,14 @@ Well-known sites
                      callers see ``EngineBackpressure`` once the bounded
                      queue backs up.  Queried via :func:`take` (the
                      engine defers rather than raises).
+``kv_migrate_drop``  severs a prefill→decode KV migration between the
+                     source engine's block-table export and the
+                     destination's adopt; index = fleet request id.  The
+                     fleet must reconcile refcounts on BOTH pools (the
+                     source donates the prompt's blocks to its prefix
+                     tree, the destination never allocated) and replay
+                     the request by deterministic re-prefill with token
+                     identity.
 ``slow_decode``      per-iteration stall of the replica decoding fleet
                      request ``index``: the replica sleeps
                      ``fleet.SLOW_DECODE_STALL_S`` before its decode
@@ -124,6 +132,7 @@ _EXC = {
     "decode_stall": InjectedFault,   # consumed via take(); never raised
     "router_queue": InjectedFault,
     "kv_pool_exhausted": InjectedFault,   # consumed via take(); never raised
+    "kv_migrate_drop": InjectedFault,
     "slow_decode": InjectedFault,         # consumed via take(); never raised
 }
 
@@ -241,7 +250,7 @@ _flags.define_flag(
     "Deterministic fault-injection schedule for resilience testing: "
     "'site@index[*count];...' with sites ckpt_write/ckpt_crash/preempt/"
     "loader/nan_loss/serving_prefill/replica_crash/decode_stall/"
-    "slow_decode/router_queue/kv_pool_exhausted (see "
+    "slow_decode/router_queue/kv_pool_exhausted/kv_migrate_drop (see "
     "paddle_tpu.resilience.faultinject).  Empty disables injection.")
 _flags.register_flag_observer("FLAGS_fault_schedule",
                               lambda v: set_schedule(v or None))

@@ -5,10 +5,15 @@ device-resident KV arena, vLLM-style admission specialised to TPU static
 shapes) plus the sampling helpers it shares with ``GPT.generate``, and the
 elastic multi-replica layer on top: ``ServingFleet`` runs N engines behind
 an SLO-aware ``Router`` with heartbeat health-checking and fault-driven
-drain/respawn.  See ``serving.engine`` / ``serving.fleet`` for the design
-notes and README "Serving" / "Elastic serving" for the API tour.
+drain/respawn.  A paged fleet can run disaggregated — prefill replicas
+hand finished prompts to decode replicas by block-granular KV migration,
+with ``FleetAutoscaler`` rebalancing the split from health-plane burn
+alerts.  See ``serving.engine`` / ``serving.fleet`` for the design notes
+and README "Serving" / "Elastic serving" / "Disaggregated serving" for
+the API tour.
 """
 
+from .autoscale import FleetAutoscaler  # noqa: F401
 from .engine import (EngineBackpressure, EngineClosed, LLMEngine,  # noqa: F401
                      Request, bucket_length)
 from .fleet import FleetRequest, Replica, ServingFleet  # noqa: F401
@@ -22,6 +27,6 @@ from .speculative import SpeculativeLLMEngine  # noqa: F401
 __all__ = ["LLMEngine", "PagedLLMEngine", "SpeculativeLLMEngine", "Request",
            "EngineBackpressure", "EngineClosed", "bucket_length",
            "filter_logits", "sample_tokens", "residual_sample",
-           "ServingFleet", "FleetRequest", "Replica",
+           "ServingFleet", "FleetRequest", "Replica", "FleetAutoscaler",
            "Router", "RetryAfter", "BlockPool", "BlockPoolExhausted",
            "PrefixCache", "blocks_for_tokens"]

@@ -105,7 +105,7 @@ class Request:
                  "temperature", "top_k", "top_p", "eos_token_id", "seed",
                  "state", "finish_reason", "tokens", "slot", "arrival_ns",
                  "last_emit_ns", "deadline", "_cancel", "_engine", "error",
-                 "tag", "trace")
+                 "tag", "trace", "hold")
 
     def __init__(self, rid, prompt, max_new_tokens, do_sample, temperature,
                  top_k, top_p, eos_token_id, seed, deadline, engine):
@@ -131,6 +131,7 @@ class Request:
         self._engine = engine
         self.tag = None           # opaque owner backref (fleet router)
         self.trace = None         # TraceContext when request tracing is on
+        self.hold = False         # park after prefill for KV migration
 
     @property
     def is_finished(self):
@@ -433,7 +434,7 @@ class LLMEngine:
     def add_request(self, prompt, max_new_tokens=32, do_sample=False,
                     temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                     seed=None, deadline_s=None, block=True, timeout=None,
-                    trace_ctx=None):
+                    trace_ctx=None, hold_after_prefill=False):
         """Enqueue one prompt; returns the live ``Request`` handle.
 
         Backpressure: when the bounded queue is full, ``block=False``
@@ -445,7 +446,12 @@ class LLMEngine:
         tokens it produced.  ``trace_ctx`` carries a caller-minted
         ``TraceContext`` (the fleet threads the SAME context through
         every retry attempt); with tracing sampled on and no context
-        given, the engine mints its own."""
+        given, the engine mints its own.  ``hold_after_prefill`` parks the
+        request after its last prefill chunk (state ``"held"``) instead of
+        entering decode, emitting a ``{"type": "prefilled"}`` event — the
+        disaggregated fleet's hand-off point for KV migration to a decode
+        replica.  Honored by the paged engine; slot-layout engines decode
+        in place (there is no block table to migrate)."""
         if self._closed:
             raise EngineClosed("engine is drained; no new requests")
         ids = np.asarray(
@@ -467,6 +473,7 @@ class LLMEngine:
                       bool(do_sample), float(temperature), int(top_k),
                       float(top_p), (None if eos is None else int(eos)),
                       int(seed), deadline, self)
+        req.hold = bool(hold_after_prefill)
         req.trace = trace_ctx if trace_ctx is not None \
             else rtrace.new_trace(req.rid)
         if req.trace is not None:

@@ -30,16 +30,38 @@ in-flight work:
   request whose retry budget is exhausted — or whose replay diverges — is
   surfaced with ``finish_reason="retried"`` and its partial tokens.
 
+* **Disaggregated prefill/decode** (``prefill_replicas > 0``, paged KV
+  only) — prefill replicas run chunked prefill and park the finished
+  request (``hold_after_prefill``); the fleet then migrates its KV to a
+  decode replica *by block table*: the prompt prefix is re-resolved
+  against the destination's radix tree (shared blocks adopt by refcount
+  transfer and never move), and only the unshared tail is copied
+  device-to-device in one fixed-shape gather/scatter.  Decode replicas
+  keep the one-decode-program / zero-steady-retrace economics; a
+  migration severed in flight (``kv_migrate_drop`` fault, or the source
+  dying mid-copy) costs exactly one deterministic re-prefill replay —
+  both pools reconcile and no request is lost.
+* **Autoscaling** (``autoscale=True``) — a
+  :class:`serving.autoscale.FleetAutoscaler` reads the health plane's
+  burn-rate alerts (ITL / TTFT / queue-wait) each scheduler tick and
+  rebalances the prefill:decode split: flips replica roles, grows the
+  starved pool, retires idle self-spawned replicas after a cooldown.
+
 The invariant the chaos tests gate: **zero lost requests under churn** —
 every admitted request terminates with a definite ``finish_reason`` —
 and, with no faults injected, fleet output is token-identical to a
 single ``LLMEngine`` (which is itself token-identical to sequential
-``GPT.generate``).
+``GPT.generate``).  Disaggregation preserves token identity: the decode
+replica continues the exact PRNG chain and KV state the prefill replica
+produced.
 
-Counters: ``serving.fleet.dispatched / shed / retried / respawns /
-heartbeat_misses / replica_deaths[.reason] / completed[.reason] /
-replayed_tokens / lost`` plus the ``serving.fleet.replicas`` and
-``serving.fleet.decode_tps`` (aggregate tokens/s) gauges.
+Counters: ``serving.fleet.dispatched / shed / health_shed / retried /
+respawns / heartbeat_misses / replica_deaths[.reason] /
+completed[.reason] / replayed_tokens / lost`` and the migration set
+``serving.fleet.migrate.requests / blocks_copied / blocks_shared /
+tokens / dropped / failed``, plus the ``serving.fleet.replicas``,
+``serving.fleet.decode_tps`` (aggregate tokens/s) and
+``serving.autoscale.prefill_replicas / decode_replicas`` gauges.
 """
 
 from __future__ import annotations
@@ -57,8 +79,10 @@ from ..profiler import health as _health
 from ..profiler import trace as rtrace
 from ..profiler.host_tracer import span
 from ..resilience import faultinject
+from .autoscale import FleetAutoscaler
 from .engine import (EngineBackpressure, EngineClosed, LLMEngine,
                      bucket_length)
+from .kvcache import BlockPoolExhausted
 from .router import RetryAfter, Router
 
 __all__ = ["FleetRequest", "Replica", "ServingFleet"]
@@ -180,24 +204,35 @@ class FleetRequest:
 
 class Replica:
     """One ``LLMEngine`` + its health/lifecycle state (and, in threaded
-    fleets, its worker thread)."""
+    fleets, its worker thread).
 
-    def __init__(self, idx, engine):
+    ``role`` is ``None`` for a unified replica, ``"prefill"`` or
+    ``"decode"`` in a disaggregated fleet — it only steers routing and
+    the hold-after-prefill flag; the engine itself is role-agnostic.
+    ``_step_lock`` serializes this replica's donating dispatches
+    (``engine.step()``) against a migration adopting INTO it from
+    another replica's thread — both donate the destination pools, and
+    XLA donation requires exclusive ownership of the buffers."""
+
+    def __init__(self, idx, engine, role=None):
         self.idx = idx
         self.engine = engine
+        self.role = role              # None | "prefill" | "decode"
         self.alive = True
         self.warmed = False
         self.hung = False             # decode_stall: stepping stopped
-        self.dead_reason = None       # crash | stall
+        self.dead_reason = None       # crash | stall | retired
         self.steps = 0
         self.last_beat = time.monotonic()
         self.thread = None
         self._kill = threading.Event()
         self._wake = threading.Event()
+        self._step_lock = threading.Lock()
 
     def __repr__(self):
-        return (f"Replica({self.idx}, alive={self.alive}, "
-                f"steps={self.steps}, dead_reason={self.dead_reason!r})")
+        return (f"Replica({self.idx}, role={self.role!r}, "
+                f"alive={self.alive}, steps={self.steps}, "
+                f"dead_reason={self.dead_reason!r})")
 
 
 class ServingFleet:
@@ -213,6 +248,16 @@ class ServingFleet:
     prompt lengths (plus the decode program) on every replica at spawn;
     buckets seen at submit time are added to the set, so a respawned
     replica is warmed for the live traffic mix before it joins dispatch.
+
+    ``prefill_replicas=P`` starts the fleet disaggregated: the first P
+    replicas take the ``"prefill"`` role, the rest ``"decode"``
+    (requires ``kv_layout="paged"`` — migration is block-granular — and
+    ``P < replicas`` so at least one decode replica exists).
+    ``autoscale=True`` attaches a :class:`FleetAutoscaler`
+    (``autoscale_kw`` forwards to its constructor) that rebalances the
+    split from the health plane's burn alerts; ``health_kw`` forwards to
+    the fleet's :class:`HealthMonitor` (e.g. ``rules=`` / ``interval_s=``
+    overrides for test-scale thresholds).
     """
 
     def __init__(self, model, replicas=2, max_slots=4, max_seq_len=None,
@@ -221,8 +266,21 @@ class ServingFleet:
                  max_retries=1, warm_buckets=(), router=None,
                  kv_layout="slots", block_size=16, n_blocks=None,
                  prefill_chunk=None, prefix_cache=True, kv_dtype=None,
-                 weight_dtype=None, draft_model=None, spec_k=4):
+                 weight_dtype=None, draft_model=None, spec_k=4,
+                 prefill_replicas=0, autoscale=False, autoscale_kw=None,
+                 health_kw=None):
         self.model = model
+        prefill_replicas = int(prefill_replicas)
+        if prefill_replicas:
+            if kv_layout != "paged":
+                raise ValueError(
+                    "disaggregated prefill/decode requires "
+                    "kv_layout='paged': KV migrates between replicas by "
+                    "block table")
+            if prefill_replicas >= int(replicas):
+                raise ValueError(
+                    f"prefill_replicas={prefill_replicas} must leave at "
+                    f"least one decode replica (replicas={replicas})")
         self._engine_kw = dict(max_slots=max_slots, max_seq_len=max_seq_len,
                                queue_size=queue_size, min_bucket=min_bucket,
                                eos_token_id=eos_token_id,
@@ -242,8 +300,11 @@ class ServingFleet:
         # the health plane: construction is free; every tick is gated on
         # FLAGS_health inside maybe_tick().  The router shares the
         # monitor so Router.stats()["health"] serves the same view.
-        self.health = _health.HealthMonitor(fleet=self)
+        self.health = _health.HealthMonitor(fleet=self,
+                                            **(health_kw or {}))
         self.router.health = self.health
+        self.autoscaler = (FleetAutoscaler(self, **(autoscale_kw or {}))
+                           if autoscale else None)
         self.threaded = bool(threaded)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.max_retries = int(max_retries)
@@ -251,6 +312,10 @@ class ServingFleet:
         self._replicas: list[Replica] = []
         self._requests: list[FleetRequest] = []   # every admitted request
         self._pending: deque = deque()            # retries awaiting room
+        # migrations deferred on decode-side backpressure: the request
+        # stays parked ("held") on its source replica, KV intact, and the
+        # hand-off retries from the source's scheduler loop
+        self._held_migrations: deque = deque()
         self._closed = False
         self._idx = itertools.count()
         self._rid = itertools.count()
@@ -261,11 +326,15 @@ class ServingFleet:
         self._warm_lens = {bucket_length(int(n), self._min_bucket,
                                          self._seq_len)
                            for n in warm_buckets}
-        first = Replica(next(self._idx), probe)
+        roles = ([None] * int(replicas) if not prefill_replicas
+                 else ["prefill"] * prefill_replicas
+                 + ["decode"] * (int(replicas) - prefill_replicas))
+        first = Replica(next(self._idx), probe, role=roles[0])
         self._warm(first)
         self._install(first)
-        for _ in range(int(replicas) - 1):
-            self._spawn()
+        for role in roles[1:]:
+            self._spawn(role=role)
+        self._publish_roles()
         self._monitor_stop = threading.Event()
         self._monitor_thread = None
         if self.threaded:
@@ -281,12 +350,41 @@ class ServingFleet:
     def _candidates(self):
         return [r for r in self._alive() if r.warmed]
 
-    def _spawn(self):
+    def _spawn(self, role=None):
         """Create + warm a replica, then let it join dispatch."""
         rep = Replica(next(self._idx), LLMEngine(self.model,
-                                                 **self._engine_kw))
+                                                 **self._engine_kw),
+                      role=role)
         self._warm(rep)
         self._install(rep)
+        return rep
+
+    def _has_role(self, role):
+        with self._lock:
+            return any(r.role == role for r in self._replicas
+                       if r.alive and r.warmed)
+
+    def _publish_roles(self):
+        alive = self._alive()
+        counters.set_gauge("serving.autoscale.prefill_replicas",
+                           sum(1 for r in alive if r.role == "prefill"))
+        counters.set_gauge("serving.autoscale.decode_replicas",
+                           sum(1 for r in alive if r.role == "decode"))
+
+    def set_role(self, rep, role):
+        """Flip one replica's fleet role (the autoscaler's rebalance
+        primitive).  In-flight requests are untouched — they finish where
+        they run; only FUTURE routing and hold-after-prefill decisions
+        see the new role."""
+        rep.role = role
+        self._publish_roles()
+
+    def spawn_replica(self, role=None):
+        """Grow the fleet by one warmed replica (autoscaler/public API)."""
+        if self._closed:
+            return None
+        rep = self._spawn(role=role)
+        self._publish_roles()
         return rep
 
     def _install(self, rep):
@@ -316,10 +414,44 @@ class ServingFleet:
                     eng.step()
                 counters.inc("serving.fleet.warmup_requests")
 
-    def _respawn(self):
-        rep = self._spawn()
+    def _respawn(self, role=None):
+        rep = self._spawn(role=role)
         counters.inc("serving.fleet.respawns")
         return rep
+
+    def retire_replica(self, rep):
+        """Gracefully shrink the fleet by one replica (autoscaler scale-
+        down): the replica leaves dispatch, its engine closes, and any
+        work it still held is requeued WITHOUT burning retry budget or
+        death counters — a retire is an operator decision, not a fault.
+        The autoscaler only retires idle replicas, so the requeue set is
+        normally empty."""
+        with self._lock:
+            if not rep.alive:
+                return
+            rep.alive = False
+            rep.dead_reason = "retired"
+        rep._kill.set()
+        counters.set_gauge("serving.fleet.replicas", len(self._alive()))
+        eng = rep.engine
+        with eng._cond:
+            eng._closed = True
+            stranded = ([r for r in eng._slots if r is not None]
+                        + list(eng._queue))
+            eng._queue.clear()
+            eng._cond.notify_all()
+        eng.release_kv()
+        for er in stranded:
+            freq = er.tag
+            er.tag = None
+            if freq is None:
+                continue
+            with freq._lock:
+                if freq.state == "finished" or freq._er is not er:
+                    continue
+                freq._er = None
+            self._requeue(freq)
+        self._publish_roles()
 
     def _replica_died(self, rep, reason, exc=None):
         """Drain a dead replica: mark it, respawn a warmed replacement,
@@ -382,9 +514,12 @@ class ServingFleet:
             requeue.append(freq)
         # replacement first (warmed before joining dispatch), so survivors
         # plus the fresh replica share the requeued load — and so requeue
-        # still works when the dead replica was the last one standing
+        # still works when the dead replica was the last one standing.
+        # The replacement inherits the dead replica's role: a crash must
+        # not silently shrink one side of a disaggregated fleet.
         if not self._closed or requeue:
-            self._respawn()
+            self._respawn(role=rep.role)
+        self._publish_roles()
         for freq in requeue:
             if freq._cancel:
                 freq._finish("cancelled")
@@ -432,8 +567,12 @@ class ServingFleet:
         est = int(ids.shape[0]) + int(max_new_tokens)
         t0_tr = (time.perf_counter_ns() if freq.trace is not None else 0)
         try:
-            rep = self.router.pick(self._candidates(), est_tokens=est,
-                                   deadline_s=deadline_s, prompt=ids)
+            # disaggregated fleet: new admissions land on a prefill
+            # replica; the KV hand-off routes them to decode afterwards
+            rep = self.router.pick(
+                self._candidates(), est_tokens=est,
+                deadline_s=deadline_s, prompt=ids,
+                role="prefill" if self._has_role("prefill") else None)
         except RetryAfter:
             if freq.trace is not None:
                 rtrace.finish(freq.trace, "shed")
@@ -464,13 +603,21 @@ class ServingFleet:
                 self._candidates(),
                 est_tokens=freq.kw["max_new_tokens"] - len(freq.tokens),
                 shed=False,    # requeues were admitted: never shed
-                prompt=freq.prompt)
+                prompt=freq.prompt,
+                role="prefill" if self._has_role("prefill") else None)
         left = None
         if freq.deadline is not None:
             left = max(0.0, freq.deadline - time.monotonic())
+        # a prefill replica parks the request after its last prefill
+        # chunk ("held") and emits the first token; _absorb's "prefilled"
+        # event then migrates the KV to a decode replica.  Hold only when
+        # a decode replica exists to receive the hand-off — otherwise the
+        # request would park forever.
+        hold = rep.role == "prefill" and self._has_role("decode")
         er = rep.engine.add_request(freq.prompt, seed=freq.seed,
                                     deadline_s=left, block=False,
-                                    trace_ctx=freq.trace, **freq.kw)
+                                    trace_ctx=freq.trace,
+                                    hold_after_prefill=hold, **freq.kw)
         er.tag = freq
         if freq.trace is not None and freq.retries > 0:
             freq.trace.add_event("redispatch", replica=rep.idx,
@@ -540,6 +687,7 @@ class ServingFleet:
         if rep.hung:
             return True
         self._flush_pending(rep)
+        self._retry_migrations(rep)
         eng = rep.engine
         if not eng.has_work():
             rep.last_beat = time.monotonic()   # idle replica is healthy
@@ -547,7 +695,12 @@ class ServingFleet:
         self._inject_faults(rep)
         if rep.hung:
             return True
-        events = eng.step()
+        # the step lock serializes this replica's donating dispatches
+        # against a migration adopting into it from another thread; the
+        # lock covers ONLY the engine step (not _absorb), so a migration
+        # triggered below takes the DESTINATION's lock with no lock held
+        with rep._step_lock:
+            events = eng.step()
         rep.steps += 1
         rep.last_beat = time.monotonic()       # per-step heartbeat
         self._absorb(rep, events)
@@ -568,11 +721,157 @@ class ServingFleet:
                     er.tag = None
                     er.cancel()
                     freq._finish("retried")
+            elif ev["type"] == "prefilled":
+                # disaggregation hand-off: the request finished chunked
+                # prefill on this (prefill) replica and is parked; move
+                # its KV to a decode replica by block table
+                self._migrate(freq, rep, er)
             elif ev["type"] == "finished":
                 with freq._lock:
                     stale = freq._er is not er
                 if not stale:
                     freq._finish(er.finish_reason, er.error)
+
+    # -- KV migration (disaggregated hand-off) -------------------------------
+    def _migrate(self, freq, src, er):
+        """Move a held request's KV from ``src`` (prefill role) to a
+        decode replica, block-granular:
+
+        1. ``export_request`` snapshots the block table + decode-state
+           row on the source (no copies, no mutation — a severed
+           migration loses nothing);
+        2. the router picks a decode replica (``shed=False``: the request
+           is already admitted);
+        3. ``adopt_migration`` re-resolves the prompt prefix against the
+           destination's radix tree and device-copies ONLY the unshared
+           tail blocks (one fixed-shape gather/scatter, under the
+           destination's step lock — donation needs exclusive buffers);
+        4. the fleet handle re-points to the new engine request and the
+           source releases its copy (``finish_migrated`` donates the
+           sequence's blocks to the source prefix tree, so a later
+           replay re-prefills as a prefix hit).
+
+        Any failure between export and adopt — the ``kv_migrate_drop``
+        chaos site, no decode capacity, destination pool exhausted —
+        aborts cleanly: both pools reconcile and the request replays
+        from scratch with token identity (same id, same seed)."""
+        eng = src.engine
+        t0_tr = time.perf_counter_ns()
+        try:
+            mig = eng.export_request(er)
+        except RuntimeError:
+            return    # finished/evicted between emit and absorb: not held
+        try:
+            faultinject.maybe_fault("kv_migrate_drop", freq.rid)
+            dest = self.router.pick(
+                [r for r in self._candidates() if r is not src],
+                est_tokens=freq.kw["max_new_tokens"] - len(freq.tokens),
+                shed=False, role="decode")
+            with dest._step_lock:
+                new_er, info = dest.engine.adopt_migration(
+                    mig, eng, trace_ctx=freq.trace)
+        except faultinject.InjectedFault as e:
+            self._abort_migration(freq, src, er, "dropped", e)
+            return
+        except (RetryAfter, EngineBackpressure) as e:
+            # transient: no decode slot / every decode queue full RIGHT
+            # NOW.  The prefill work is done and the KV is intact on the
+            # source — park the hand-off and retry next scheduler tick
+            # instead of discarding the prefill into a replay
+            counters.inc("serving.fleet.migrate.deferred")
+            if freq.trace is not None:
+                freq.trace.add_event("migrate_deferred", error=repr(e))
+            with self._lock:
+                self._held_migrations.append((freq, src, er))
+            return
+        except (EngineClosed, BlockPoolExhausted) as e:
+            self._abort_migration(freq, src, er, "failed", e)
+            return
+        new_er.tag = freq
+        with freq._lock:
+            stale = freq.state == "finished" or freq._er is not er
+            if not stale:
+                freq._er = new_er
+                freq.replica_idx = dest.idx
+        if stale:
+            # the handle moved on while we migrated (death-requeue or a
+            # racing cancel finished it): orphan the adopted attempt
+            new_er.tag = None
+            new_er.cancel()
+        try:
+            eng.finish_migrated(er)
+        except Exception:
+            pass    # source died mid-migration; its pool is already gone
+        er.tag = None
+        if stale:
+            dest._wake.set()
+            return
+        counters.inc("serving.fleet.migrate.requests")
+        counters.inc("serving.fleet.migrate.blocks_copied",
+                     info["blocks_copied"])
+        counters.inc("serving.fleet.migrate.blocks_shared",
+                     info["blocks_shared"])
+        counters.inc("serving.fleet.migrate.tokens", info["tokens"])
+        if freq.trace is not None:
+            freq.trace.add_span("kv.migrate", t0_tr,
+                                time.perf_counter_ns(),
+                                src=src.idx, dest=dest.idx, **info)
+        flight.record("serving.fleet.migrate", rid=freq.rid,
+                      src=src.idx, dest=dest.idx, **info)
+        if freq._cancel:
+            new_er.cancel()
+        dest._wake.set()
+
+    def _retry_migrations(self, rep):
+        """Re-attempt hand-offs parked on decode-side backpressure whose
+        SOURCE is ``rep`` — run from rep's own scheduler loop, before its
+        engine step, so the source pools are quiescent while the
+        migration gather reads them as operands."""
+        if not self._held_migrations:
+            return
+        with self._lock:
+            mine = [m for m in self._held_migrations if m[1] is rep]
+            if not mine:
+                return
+            self._held_migrations = deque(
+                m for m in self._held_migrations if m[1] is not rep)
+        for freq, src, er in mine:
+            with freq._lock:
+                stale = freq.state == "finished" or freq._er is not er
+            if stale or not src.alive:
+                continue    # the death/cancel path already owns these
+            self._migrate(freq, src, er)
+
+    def _abort_migration(self, freq, src, er, kind, exc):
+        """Unwind a migration that failed between export and adopt:
+        release the source's copy (block refcounts reconcile — the
+        destination either never allocated or already rolled back) and
+        requeue the request for a deterministic re-prefill replay.
+        ``kind`` is ``"dropped"`` (injected ``kv_migrate_drop``) or
+        ``"failed"`` (no decode capacity / destination pool exhausted)."""
+        counters.inc(f"serving.fleet.migrate.{kind}")
+        if freq.trace is not None:
+            freq.trace.add_event("migrate_aborted", kind=kind,
+                                 replica=src.idx, error=repr(exc))
+        flight.record("serving.fleet.migrate_abort", rid=freq.rid,
+                      why=kind, src=src.idx, error=repr(exc))
+        try:
+            src.engine.finish_migrated(er)
+        except Exception:
+            pass
+        er.tag = None
+        with freq._lock:
+            if freq.state == "finished" or freq._er is not er:
+                return
+            freq._er = None
+        if freq._cancel:
+            freq._finish("cancelled")
+        elif freq.retries >= self.max_retries:
+            freq._finish("retried")
+        else:
+            freq.retries += 1
+            counters.inc("serving.fleet.retried")
+            self._requeue(freq)
 
     def check_health(self):
         """The stall detector: a replica with outstanding work whose
@@ -603,6 +902,8 @@ class ServingFleet:
                 rep.last_beat = now
         self.check_health()
         self.health.maybe_tick()
+        if self.autoscaler is not None:
+            self.autoscaler.maybe_scale()
         progressed = False
         for rep in self._alive():
             try:
@@ -638,6 +939,8 @@ class ServingFleet:
             try:
                 self.check_health()
                 self.health.maybe_tick()
+                if self.autoscaler is not None:
+                    self.autoscaler.maybe_scale()
                 if self._pending:
                     for rep in self._candidates():
                         self._flush_pending(rep)
@@ -746,13 +1049,23 @@ class ServingFleet:
         for rep in replicas:
             st = rep.engine.stats()
             st.update(idx=rep.idx, alive=rep.alive, hung=rep.hung,
-                      steps=rep.steps, dead_reason=rep.dead_reason)
+                      steps=rep.steps, dead_reason=rep.dead_reason,
+                      role=rep.role)
             reps.append(st)
             if rep.alive:
                 agg += st["decode_tps_ema"]
         counters.set_gauge("serving.fleet.decode_tps", agg)
         out = {"replicas": reps,
                "alive": sum(r.alive for r in replicas),
+               "roles": {
+                   "prefill": sum(1 for r in replicas
+                                  if r.alive and r.role == "prefill"),
+                   "decode": sum(1 for r in replicas
+                                 if r.alive and r.role == "decode"),
+                   "unified": sum(1 for r in replicas
+                                  if r.alive and r.role is None),
+               },
+               "migrated": counters.get("serving.fleet.migrate.requests"),
                "decode_tps": agg,
                "latency": self.router.latency_summary(replicas),
                "pending_retries": pending,
@@ -802,4 +1115,6 @@ class ServingFleet:
                 "acceptance": acc,
             }
             counters.set_gauge("serving.fleet.spec_acceptance", acc)
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.summary()
         return out

@@ -226,15 +226,38 @@ class PrefixCache:
         ``blocks`` are fully-shared block ids (each RETAINED for the
         caller — release them on admission failure), ``cached`` counts
         their tokens, and ``partial_node``/``partial_tokens`` describe a
-        terminal partial block usable via copy-on-write (NOT retained:
-        the caller copies it synchronously under the engine lock).
+        terminal partial block usable via copy-on-write.  The partial's
+        block is RETAINED too: the caller releases it after the COW copy
+        (or on admission failure), and the tree keeps its OWN retain so
+        the node survives for the next sharer — without the caller-side
+        retain, the COW release would strip the tree's reference and
+        leave a dangling partial node over a freed (and eventually
+        reused) block.
         """
         tokens = [int(t) for t in tokens[:max(0, int(limit))]]
         node, blocks, cached = self._walk_full(tokens, limit, touch=True)
         for b in blocks:
             self.pool.retain(b)
         pn, p = self._best_partial(node, tokens, cached, limit, touch=True)
+        if pn is not None:
+            self.pool.retain(pn.block)
         return blocks, cached, pn, p
+
+    def match_full(self, tokens, limit):
+        """Full-block-only :meth:`match`: the longest fully-cached block
+        run, with NO terminal-partial candidate.  The KV-migration adopt
+        path uses this — a migrated request shares only whole data blocks
+        strictly below its write frontier (the block it will write next
+        must stay private), and a partial adoption would be exactly the
+        COW device copy the migration is trying to avoid.  Returns
+        ``(blocks, cached)``; every block is RETAINED on this pool for
+        the caller (the refcount transfer: release them on adopt
+        failure)."""
+        tokens = [int(t) for t in tokens[:max(0, int(limit))]]
+        _, blocks, cached = self._walk_full(tokens, limit, touch=True)
+        for b in blocks:
+            self.pool.retain(b)
+        return blocks, cached
 
     def peek(self, tokens, limit):
         """Read-only :meth:`match`: how many leading tokens the cache

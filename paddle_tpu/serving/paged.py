@@ -28,8 +28,9 @@ logical block index to a physical pool block.  Three consequences:
 TPU discipline is unchanged from the slot engine: block tables ride the
 compiled programs as int32 OPERANDS (never shape inputs), so steady
 state stays O(log prefill_chunk) chunk programs + ONE decode program +
-one COW copy program with zero retraces; the pool is donated through
-every launch.  Sampling replicates ``GPT.generate``'s key-split chain
+one COW copy program (+ one fixed-shape migration gather/scatter when a
+disaggregated fleet hands block tables between replicas) with zero
+retraces; the pool is donated through every launch.  Sampling replicates ``GPT.generate``'s key-split chain
 exactly (only the final chunk's sample is consumed), so paged output is
 token-identical to the slot engine and to sequential ``generate``.
 """
@@ -48,8 +49,10 @@ from ..profiler import flight
 from ..profiler import metrics
 from ..profiler import trace as rtrace
 from ..profiler.host_tracer import span
-from .engine import LLMEngine, _model_programs, bucket_length
-from .kvcache import BlockPool, PrefixCache, blocks_for_tokens
+from .engine import (EngineBackpressure, EngineClosed, LLMEngine, Request,
+                     _model_programs, bucket_length)
+from .kvcache import (BlockPool, BlockPoolExhausted, PrefixCache,
+                      blocks_for_tokens)
 
 __all__ = ["PagedLLMEngine"]
 
@@ -120,6 +123,7 @@ class PagedLLMEngine(LLMEngine):
         self._pchunk_jits = {}        # chunk bucket -> jitted prefill
         self._pdecode_jit = None
         self._pcopy_jit = None
+        self._pmigrate_jit = None
         # per-engine prefix-cache accounting (the fleet sums these; the
         # same events also feed the process-global counters registry)
         self.kv_prefix_hits = 0
@@ -290,6 +294,63 @@ class PagedLLMEngine(LLMEngine):
                 progs[key] = fn
             self._pcopy_jit = fn
         return self._pcopy_jit
+
+    def _pmigrate(self):
+        """Block-granular KV migration: gather up to ``max_blocks``
+        source-pool blocks and scatter them into destination-pool blocks
+        in ONE fixed-shape dispatch.  The id vectors ride as int32
+        OPERANDS padded to ``max_blocks`` (``n`` masks the live lanes),
+        so the program never retraces on migration size; padded lanes
+        gather the source trash block and scatter zeros back into the
+        destination trash block.  Only the DESTINATION pools are donated
+        — the source engine keeps serving from its arena until the fleet
+        releases the migrated request (a severed migration loses
+        nothing)."""
+        if self._pmigrate_jit is None:
+            progs = _model_programs(self.model)
+            key = self._prog_key("migrate_blocks")
+            fn = progs.get(key)
+            if fn is None:
+                def _gather(spk, spv, src_ids, m5):
+                    kb = jnp.take(spk, src_ids, axis=1)
+                    vb = jnp.take(spv, src_ids, axis=1)
+                    kb = jnp.where(m5, kb, jnp.zeros((), kb.dtype))
+                    vb = jnp.where(m5, vb, jnp.zeros((), vb.dtype))
+                    return kb, vb
+
+                if self.kv_dtype:
+                    def migrate(pk, pv, sk, sv, spk, spv, ssk, ssv,
+                                src_ids, dst_ids, n):
+                        counters.inc("serving.retraces")
+                        m = jnp.arange(src_ids.shape[0]) < n
+                        kb, vb = _gather(spk, spv, src_ids,
+                                         m[None, :, None, None, None])
+                        ids = jnp.where(m, dst_ids, 0)
+                        pk = pk.at[:, ids].set(kb)
+                        pv = pv.at[:, ids].set(vb)
+                        m3 = m[None, :, None]
+                        skb = jnp.where(
+                            m3, jnp.take(ssk, src_ids, axis=1), 0.0)
+                        svb = jnp.where(
+                            m3, jnp.take(ssv, src_ids, axis=1), 0.0)
+                        sk = sk.at[:, ids].set(skb)
+                        sv = sv.at[:, ids].set(svb)
+                        return pk, pv, sk, sv
+                    fn = jax.jit(migrate, donate_argnums=(0, 1, 2, 3))
+                else:
+                    def migrate(pk, pv, spk, spv, src_ids, dst_ids, n):
+                        counters.inc("serving.retraces")
+                        m = jnp.arange(src_ids.shape[0]) < n
+                        kb, vb = _gather(spk, spv, src_ids,
+                                         m[None, :, None, None, None])
+                        ids = jnp.where(m, dst_ids, 0)
+                        pk = pk.at[:, ids].set(kb)
+                        pv = pv.at[:, ids].set(vb)
+                        return pk, pv
+                    fn = jax.jit(migrate, donate_argnums=(0, 1))
+                progs[key] = fn
+            self._pmigrate_jit = fn
+        return self._pmigrate_jit
 
     # -- request intake ------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, **kw):
@@ -485,8 +546,6 @@ class PagedLLMEngine(LLMEngine):
         if last:
             del self._prefill_state[slot]
             counters.inc("serving.prefill_batches")
-            req.state = "running"
-            self._running[slot] = True
             self._tok[slot] = int(tok)
             self._pos[slot] = T
             self._keys[slot] = np.asarray(new_key)
@@ -494,7 +553,23 @@ class PagedLLMEngine(LLMEngine):
             self._topk[slot] = req.top_k
             self._topp[slot] = req.top_p
             self._dosample[slot] = req.do_sample
-            self._emit(req, int(tok), events)
+            if req.hold:
+                # disaggregated hand-off point: the row parks instead of
+                # entering decode — _running stays False so the decode
+                # launch tables it to the trash block — until the fleet
+                # migrates its block table to a decode replica.  The
+                # first token was already sampled by the final chunk, so
+                # it is emitted here (TTFT is a prefill-side metric);
+                # _emit may finish the request (EOS / max_new == 1), in
+                # which case there is nothing left to migrate.
+                req.state = "held"
+                self._emit(req, int(tok), events)
+                if req.state == "held":
+                    events.append({"type": "prefilled", "request": req})
+            else:
+                req.state = "running"
+                self._running[slot] = True
+                self._emit(req, int(tok), events)
 
     def _prefill_chunks(self, events):
         """One chunk per prefilling slot per step (round-robin in slot
@@ -574,6 +649,203 @@ class PagedLLMEngine(LLMEngine):
             self._tok[s] = nxt[s]
             self._pos[s] += 1
             self._emit(req, nxt[s], events)
+
+    # -- KV migration (disaggregated prefill/decode fleet) -------------------
+    def export_request(self, req):
+        """Snapshot a held request's migration payload: block table,
+        decode-state row and committed tokens — NO device copies and no
+        mutation, so the source stays fully intact until
+        :meth:`finish_migrated` and a migration severed in flight loses
+        nothing.  KV is valid for positions ``[0, pos)``; the last
+        committed token (``tok``) was sampled but never written back —
+        exactly the prefix-tree donation contract."""
+        with self._cond:
+            slot = req.slot
+            if slot is None or req.state != "held":
+                raise RuntimeError(
+                    f"request {req.rid} is not held for migration "
+                    f"(state={req.state!r})")
+            return {
+                "prompt": req.prompt,
+                "tokens": list(req.tokens),
+                "max_new_tokens": req.max_new_tokens,
+                "do_sample": req.do_sample,
+                "temperature": req.temperature,
+                "top_k": req.top_k,
+                "top_p": req.top_p,
+                "eos_token_id": req.eos_token_id,
+                "seed": req.seed,
+                "deadline": req.deadline,
+                "arrival_ns": req.arrival_ns,
+                "last_emit_ns": req.last_emit_ns,
+                "tok": int(self._tok[slot]),
+                "pos": int(self._pos[slot]),
+                "key": np.array(self._keys[slot]),
+                "table": list(self._slot_blocks[slot]),
+                "block_size": self.pool.block_size,
+                "kv_dtype": self.kv_dtype,
+            }
+
+    def adopt_migration(self, mig, src, trace_ctx=None):
+        """Install a migrated request on THIS engine (the decode side of
+        the hand-off).  The prefix is re-resolved against the
+        destination's OWN radix tree: full data blocks already cached
+        here are adopted by refcount transfer (``PrefixCache.match_full``
+        retains them on this pool — a shared prefix never moves twice),
+        and only the unshared tail of the source block table is
+        device-copied, in one bounded :meth:`_pmigrate` dispatch.  Raises
+        ``EngineBackpressure`` / ``BlockPoolExhausted`` with NOTHING
+        allocated when this engine cannot host the request (the fleet
+        then replays it by deterministic re-prefill).
+
+        Returns ``(request, info)``; the installed request is already
+        ``"running"`` with the migrated tokens replayed into its stream
+        state, so its next emitted token continues the source's ITL
+        chain."""
+        if (self.pool.block_size != mig["block_size"]
+                or self.kv_dtype != mig["kv_dtype"]):
+            raise ValueError(
+                "KV migration between incompatible paged engines "
+                f"(block_size {self.pool.block_size} vs "
+                f"{mig['block_size']}, kv_dtype {self.kv_dtype!r} vs "
+                f"{mig['kv_dtype']!r})")
+        bs = self.pool.block_size
+        pos = int(mig["pos"])
+        total = len(mig["table"])
+        if total > self.max_blocks:
+            raise ValueError(
+                f"migrated table ({total} blocks) exceeds this engine's "
+                f"max_blocks ({self.max_blocks})")
+        n_data = blocks_for_tokens(max(pos, 1), bs)
+        seq = np.concatenate(
+            [mig["prompt"], np.asarray(mig["tokens"], np.int32)])[:pos]
+        t0_tr = time.perf_counter_ns() if trace_ctx is not None else 0
+        with self._cond:
+            if self._closed:
+                raise EngineClosed("engine is drained; cannot adopt")
+            if not self._free:
+                raise EngineBackpressure(
+                    "no free decode slot for migration",
+                    queue_depth=len(self._queue),
+                    retry_after_hint=self._retry_hint_locked())
+            shared, cached = [], 0
+            if self.prefix is not None:
+                # only whole blocks strictly below the write frontier are
+                # shareable: the block holding position ``pos`` will be
+                # written by the next decode step and must stay private
+                shared, cached = self.prefix.match_full(
+                    seq.tolist(), (pos // bs) * bs)
+            n_shared = len(shared)
+            fresh_needed = total - n_shared
+            shortfall = fresh_needed - self.pool.free_blocks
+            if shortfall > 0 and self.prefix is not None:
+                self.kv_blocks_evicted += self.prefix.evict(shortfall)
+                shortfall = fresh_needed - self.pool.free_blocks
+            if shortfall > 0:
+                for b in shared:
+                    self.pool.release(b)
+                self.kv_pool_exhausted_events += 1
+                counters.inc("serving.kv.pool_exhausted")
+                flight.record("serving.kv.pool_exhausted",
+                              migration=True, needed=fresh_needed,
+                              free=self.pool.free_blocks)
+                raise BlockPoolExhausted(
+                    f"migration needs {fresh_needed} blocks, "
+                    f"{self.pool.free_blocks} free",
+                    needed=fresh_needed, free=self.pool.free_blocks)
+            fresh = self.pool.alloc_n(fresh_needed)
+            table = shared + fresh
+            n_copy = n_data - n_shared
+            if n_copy > 0:
+                src_ids = np.zeros(self.max_blocks, np.int32)
+                dst_ids = np.zeros(self.max_blocks, np.int32)
+                src_ids[:n_copy] = mig["table"][n_shared:n_data]
+                dst_ids[:n_copy] = table[n_shared:n_data]
+                mg = self._pmigrate()
+                scalars = (src_ids, dst_ids, np.int32(n_copy))
+                if self.kv_dtype:
+                    margs = (self._pk, self._pv, self._sk, self._sv,
+                             src._pk, src._pv, src._sk, src._sv,
+                             *scalars)
+                    dn = (0, 1, 2, 3)
+                else:
+                    margs = (self._pk, self._pv, src._pk, src._pv,
+                             *scalars)
+                    dn = (0, 1)
+                self._maybe_capture("serving.kv.migrate_blocks", mg,
+                                    *margs)
+                self._maybe_audit("serving.kv.migrate_blocks", mg,
+                                  *margs, donate_argnums=dn)
+                # the adopt (dest prefix retains + alloc + table install
+                # + block copy) must be atomic w.r.t. this engine's
+                # scheduler — same contract as the COW adopt in _reserve
+                # ptlint: disable=PT005 reason="migration adopt is one bounded block-table copy inside the atomic reservation, not a per-token dispatch"
+                out = mg(*margs)
+                if self.kv_dtype:
+                    self._pk, self._pv, self._sk, self._sv = out
+                else:
+                    self._pk, self._pv = out
+            if cached > 0:
+                self.kv_prefix_hits += 1
+                self.kv_prefix_hit_tokens += cached
+                counters.inc("serving.kv.prefix_hits")
+                counters.inc("serving.kv.prefix_hit_tokens", cached)
+            else:
+                self.kv_prefix_misses += 1
+                counters.inc("serving.kv.prefix_misses")
+            req = Request(next(self._rid), mig["prompt"],
+                          int(mig["max_new_tokens"]),
+                          bool(mig["do_sample"]),
+                          float(mig["temperature"]), int(mig["top_k"]),
+                          float(mig["top_p"]), mig["eos_token_id"],
+                          int(mig["seed"]), mig["deadline"], self)
+            req.tokens = list(mig["tokens"])
+            req.arrival_ns = mig["arrival_ns"]
+            req.last_emit_ns = mig["last_emit_ns"]
+            req.trace = trace_ctx
+            req.state = "running"
+            slot = self._free.pop()
+            req.slot = slot
+            self._slots[slot] = req
+            self._slot_blocks[slot] = table
+            self._bt[slot] = 0
+            self._bt[slot, :len(table)] = table
+            self._running[slot] = True
+            self._tok[slot] = int(mig["tok"])
+            self._pos[slot] = pos
+            self._keys[slot] = np.asarray(mig["key"])
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._topp[slot] = req.top_p
+            self._dosample[slot] = req.do_sample
+            self._outstanding += max(
+                0, req.max_new_tokens - len(req.tokens))
+            self._adopt_extra(slot, req, mig)
+        info = {"blocks_copied": n_copy, "blocks_shared": n_shared,
+                "tokens": pos, "blocks_total": total}
+        if trace_ctx is not None:
+            trace_ctx.add_span("kv.adopt", t0_tr,
+                               time.perf_counter_ns(), **info)
+        flight.record("serving.kv.adopt", rid=req.rid, **info)
+        return req, info
+
+    def _adopt_extra(self, slot, req, mig):
+        """Subclass hook: rebuild engine-local state the migration
+        payload does not carry (the speculative engine re-prefills its
+        draft namespace here).  Caller holds ``_cond``."""
+
+    def finish_migrated(self, req):
+        """Source-side release after the destination adopted (or the
+        fleet abandoned) a migration: finish the held request with
+        reason ``"migrated"`` — ``_release_slot_kv`` donates the
+        sequence's blocks to THIS engine's prefix tree (a replayed or
+        prefix-sharing prompt re-resolves them here) and drops every
+        table reference.  The fleet re-points its stream handle BEFORE
+        calling this, so the source-side finish is invisible to the
+        consumer."""
+        done = self._finish(req, "migrated", [])
+        req.tag = None
+        return done
 
     # -- eviction / teardown -------------------------------------------------
     def _release_slot_kv(self, slot, req, reason):

@@ -43,6 +43,9 @@ class RetryAfter(EngineBackpressure):
     * ``"slo"`` — the deadline budget is already blown by the estimated
       queue delay (load shed; counted under ``serving.fleet.shed``);
     * ``"backpressure"`` — every replica's bounded queue is full;
+    * ``"health"`` — the health plane's admission level is ``critical``
+      and this is a new admission (counted under
+      ``serving.fleet.health_shed``; ``shed=False`` replays still pass);
     * ``"router_queue"`` — injected ``router_queue`` fault (chaos tests).
 
     ``queue_depth`` and ``retry_after_hint`` are inherited from
@@ -61,21 +64,38 @@ class Router:
 
     ``slo_margin`` scales the estimated completion time before comparing
     it to the deadline budget (>1.0 sheds earlier / more conservatively).
+    ``degraded_factor`` further scales that margin while the health
+    plane's admission level is ``degraded`` — the router tightens its own
+    shed threshold on its own signal (see :meth:`pick`).
     """
 
-    def __init__(self, slo_margin=1.0):
+    def __init__(self, slo_margin=1.0, degraded_factor=2.0):
         self.slo_margin = float(slo_margin)
-        # the owning ServingFleet installs its HealthMonitor here so the
-        # routing layer can expose (and later consume — ROADMAP item 3)
-        # the admission-level recommendation
+        self.degraded_factor = float(degraded_factor)
+        # the owning ServingFleet installs its HealthMonitor here; the
+        # routing policy ACTS on its admission level (degraded tightens
+        # the SLO shed margin, critical refuses new admissions) and
+        # stats() exposes the same view
         self.health = None
 
+    def _admission_level(self):
+        """Current health-plane admission level, ``"ok"`` when the plane
+        is absent or disabled (``FLAGS_health=0`` keeps the router's
+        behavior bitwise identical to the pre-health fleet)."""
+        if self.health is None:
+            return "ok"
+        from ..profiler import health as _health
+        if not _health.enabled():
+            return "ok"
+        return self.health.admission_level()
+
     def stats(self):
-        """Router-level observability: today just the health plane's
-        admission recommendation (``{"health": {..., "admission_level":
-        "ok" | "degraded" | "critical"}}``).  Recommendation only — the
-        routing policy does not act on it yet; ROADMAP item 3's
-        autoscaler is the intended consumer."""
+        """Router-level observability: the health plane's admission view
+        (``{"health": {..., "admission_level": "ok" | "degraded" |
+        "critical"}}``).  The routing policy acts on it in :meth:`pick`:
+        ``degraded`` multiplies the SLO shed margin by
+        ``degraded_factor``, ``critical`` admits only ``shed=False``
+        replays (``serving.fleet.health_shed``)."""
         if self.health is None:
             return {"health": {"enabled": False, "admission_level": "ok",
                                "alerts": [], "ticks": 0}}
@@ -119,7 +139,7 @@ class Router:
         }
 
     def pick(self, replicas, est_tokens=0, deadline_s=None, shed=True,
-             prompt=None):
+             prompt=None, role=None):
         """Choose a replica for a request costing ``est_tokens`` decode
         tokens.  ``replicas`` is the candidate list (alive + warmed).
         Raises :class:`RetryAfter` when every queue is full or — with
@@ -127,6 +147,21 @@ class Router:
         says the request cannot finish in time.  Requeued (already
         admitted) requests route with ``shed=False``: they must reach a
         terminal state, never be shed.
+
+        The router acts on its own health signal: at admission level
+        ``degraded`` the SLO margin is multiplied by ``degraded_factor``
+        (shedding earlier while the fleet burns error budget), at
+        ``critical`` every ``shed=True`` admission is refused outright
+        with ``reason="health"`` (``serving.fleet.health_shed``, also
+        counted under the umbrella ``serving.fleet.shed``) — only
+        ``shed=False`` replays, which must reach a terminal state, still
+        route.
+
+        ``role`` narrows dispatch to replicas of that fleet role
+        (``"prefill"`` / ``"decode"``); unified (role-less) replicas are
+        the fallback when no replica of the requested role is alive, and
+        the full list is the last resort — a disaggregated fleet
+        degrades to unified routing rather than refusing.
 
         With ``prompt`` (the request's token ids) the score becomes
         prefix-hit-aware: each candidate's backlog is discounted by the
@@ -136,6 +171,21 @@ class Router:
         holds the prefix instead of re-prefilling it elsewhere.  A pick
         won on a nonzero discount counts ``serving.fleet.prefix_routed``.
         """
+        level = self._admission_level()
+        if level == "critical" and shed:
+            counters.inc("serving.fleet.health_shed")
+            counters.inc("serving.fleet.shed")
+            raise RetryAfter(
+                "shed: health plane admission level is critical — only "
+                "in-flight replays are admitted",
+                queue_depth=0, retry_after_hint=None, reason="health")
+        if role is not None:
+            roled = [r for r in replicas
+                     if getattr(r, "role", None) == role]
+            if not roled:
+                roled = [r for r in replicas
+                         if getattr(r, "role", None) is None]
+            replicas = roled or replicas
         cands, hints, depths = [], [], []
         for rep in replicas:
             st = rep.engine.stats()     # atomic per-replica snapshot
@@ -185,7 +235,9 @@ class Router:
                                  / (1.0 - acc))
                 tps = tps * exp_yield / max(yld, 1e-6)
             est_done_s = (backlog + est_tokens) / tps
-            if est_done_s * self.slo_margin > float(deadline_s):
+            margin = self.slo_margin * (self.degraded_factor
+                                        if level == "degraded" else 1.0)
+            if est_done_s * margin > float(deadline_s):
                 counters.inc("serving.fleet.shed")
                 raise RetryAfter(
                     f"shed: estimated completion {est_done_s:.3f}s exceeds "

@@ -411,15 +411,22 @@ class SpeculativeLLMEngine(PagedLLMEngine):
 
     # -- chunked prefill (both namespaces) -----------------------------------
     def _run_draft_chunk(self, slot, st):
-        req = st["req"]
-        T = int(req.prompt.shape[0])
-        start = st.get("ddone", 0)
+        st["ddone"] = self._draft_prefill_tokens(
+            slot, st["req"].prompt, st.get("ddone", 0))
+
+    def _draft_prefill_tokens(self, slot, tokens, start):
+        """One draft-arena prefill chunk over ``tokens[start:]``; returns
+        the new prefilled count.  Shared by admission-time prefill (over
+        the prompt) and migration adopt (over the full committed
+        sequence — the draft namespace never migrates, it is throwaway
+        proposal state, so the destination rebuilds it locally)."""
+        T = int(len(tokens))
         remaining = T - start
         C = bucket_length(min(remaining, self.prefill_chunk),
                           self.min_bucket, self.prefill_chunk)
         take_n = min(remaining, C)
         ids = np.zeros((1, C), np.int32)
-        ids[0, :take_n] = req.prompt[start:start + take_n]
+        ids[0, :take_n] = tokens[start:start + take_n]
         with span("serving.spec.draft_prefill"):
             df = self._dchunk_for(C)
             head = (self._dw, jnp.asarray(ids), np.int32(start),
@@ -439,7 +446,7 @@ class SpeculativeLLMEngine(PagedLLMEngine):
             else:
                 self._dk, self._dv = df(*dargs)
         counters.inc("serving.spec.draft_prefill_chunks")
-        st["ddone"] = start + take_n
+        return start + take_n
 
     def _run_chunk(self, slot, st, events):
         req = st["req"]
@@ -461,6 +468,39 @@ class SpeculativeLLMEngine(PagedLLMEngine):
             # whatever the draft proposes)
             self._dkeys[slot] = np.asarray(jax.random.key_data(
                 jax.random.fold_in(jax.random.key(req.seed), 0x5BEC)))
+
+    # -- KV migration --------------------------------------------------------
+    def _adopt_extra(self, slot, req, mig):
+        """Rebuild the draft-side state for a migrated request.  The
+        draft namespace's KV is throwaway proposal state and never rides
+        a migration: the destination re-prefills the committed sequence
+        into its own draft arena here (bounded: ceil(pos/chunk) draft
+        dispatches).  A pool that cannot cover the draft table leaves
+        the row draft-starved — ``_grow_draft_tables`` downgrades it to
+        plain decode (``serving.spec.draft_starved``), so migration onto
+        a tight decode replica degrades throughput, never correctness.
+        Caller holds ``_cond``."""
+        pos = int(mig["pos"])
+        dneed = blocks_for_tokens(max(pos, 1), self.pool.block_size)
+        short = dneed - self.pool.free_blocks
+        if short > 0 and self.prefix is not None:
+            self.kv_blocks_evicted += self.prefix.evict(short)
+        if dneed > self.pool.free_blocks:
+            self._dslot_blocks[slot] = None
+            self._dbt[slot] = 0
+            counters.inc("serving.spec.draft_starved")
+            return
+        dblocks = self.pool.alloc_n(dneed)
+        self._dslot_blocks[slot] = dblocks
+        self._dbt[slot] = 0
+        self._dbt[slot, :len(dblocks)] = dblocks
+        seq = np.concatenate(
+            [mig["prompt"], np.asarray(mig["tokens"], np.int32)])[:pos]
+        done = 0
+        while done < pos:
+            done = self._draft_prefill_tokens(slot, seq, done)
+        self._dkeys[slot] = np.asarray(jax.random.key_data(
+            jax.random.fold_in(jax.random.key(req.seed), 0x5BEC)))
 
     # -- the draft/verify round ----------------------------------------------
     def _grow_draft_tables(self, nv):

@@ -81,6 +81,16 @@ K+1 draft launches + ONE verify launch per round.  The program-audit
 phase additionally serves through a speculative engine under
 ``FLAGS_program_audit=enforce`` with OFF/ON counter parity.
 
+A tenth phase gates the disaggregated prefill/decode split
+(``ServingFleet(prefill_replicas=...)``): a 1+1 split must be
+token-identical to the unified paged fleet on the same prompts, every
+hand-off must copy EXACTLY the owned non-shared KV blocks
+(``serving.fleet.migrate.blocks_copied`` equals the block-table size
+minus the block-aligned prefix resolved against the decode replica's
+radix tree — a shared prefix is never moved twice), and the measured
+hand-offs must retrace nothing once the warm pass has compiled the
+migration gather.
+
 Prints one JSON line; raises AssertionError on any violation.  Wired as a
 tier-1 test via tests/test_profiler.py.  Run directly:
 ``python scripts/check_counters.py``.
@@ -88,6 +98,7 @@ tier-1 test via tests/test_profiler.py.  Run directly:
 
 import json
 import os
+import time
 
 WARMUP = 2
 MEASURE = 2
@@ -614,6 +625,69 @@ def run():
             violations[f"fleet-churn:identity@{h.rid}"] = (list(h.tokens),
                                                            ref)
 
+    # ---- disagg gate: block-granular migration economics ----------------
+    # A 1 prefill + 1 decode split must (a) stay token-identical to the
+    # unified paged fleet, (b) copy EXACTLY the owned non-shared blocks
+    # on every hand-off — blocks_copied == sum(blocks_for_tokens(len)) -
+    # blocks_shared, with a block-aligned common prefix resolved against
+    # the decode replica's radix tree instead of moved again — and
+    # (c) retrace nothing once the warm pass has compiled the migration
+    # gather alongside the usual bucket programs.
+    DIS_BS = 4
+    DIS_LENS = (9, 9)
+    dis_p1 = rng.randint(0, 64, size=DIS_LENS[0]).tolist()
+    # same 2-block (8-token) prefix, divergent tail: the second hand-off
+    # must share those 2 blocks and copy only its owned tail block
+    dis_p2 = dis_p1[:8] + [(dis_p1[8] + 1) % 64]
+    dis_prompts = [dis_p1, dis_p2]
+
+    def disagg_fleet(prefill_replicas):
+        return ServingFleet(smodel, replicas=2,
+                            prefill_replicas=prefill_replicas,
+                            max_slots=2, max_seq_len=32, min_bucket=4,
+                            threaded=False, kv_layout="paged",
+                            block_size=DIS_BS, n_blocks=64,
+                            prefill_chunk=8, warm_buckets=DIS_LENS)
+
+    ufleet = disagg_fleet(0)   # unified paged reference, same prompts
+    drefs = []
+    for p in dis_prompts:
+        h = ufleet.submit(p, max_new_tokens=3)
+        ufleet.join([h])
+        drefs.append(list(h.tokens))
+    ufleet.drain()
+
+    dfleet = disagg_fleet(1)
+    for p in dis_prompts:      # warm pass: compiles the migrate program
+        dfleet.join([dfleet.submit(
+            rng.randint(0, 64, size=len(p)).tolist(), max_new_tokens=3)])
+    for rep in dfleet._replicas:   # measured hand-offs stay prefix-cold
+        if rep.engine.prefix is not None:
+            rep.engine.prefix.clear()
+    dbefore = counters.snapshot()
+    dhs = []
+    for p in dis_prompts:      # sequential: p1 donates before p2 lands
+        h = dfleet.submit(p, max_new_tokens=3)
+        dfleet.join([h])
+        dhs.append(h)
+    dsteady = counters.delta(dbefore)
+    dfleet.drain()
+    owned = sum(blocks_for_tokens(len(p), DIS_BS) for p in dis_prompts)
+    dinvariants = {
+        "serving.retraces": 0,
+        "jit.traces": 0,
+        "serving.fleet.lost": 0,
+        "serving.fleet.migrate.requests": len(dis_prompts),
+        "serving.fleet.migrate.blocks_shared": 2,
+        "serving.fleet.migrate.blocks_copied": owned - 2,
+    }
+    violations.update({f"disagg:{k}": (dsteady.get(k, 0), want)
+                       for k, want in dinvariants.items()
+                       if dsteady.get(k, 0) != want})
+    for h, ref in zip(dhs, drefs):
+        if list(h.tokens) != ref or h.finish_reason != "length":
+            violations[f"disagg:identity@{h.rid}"] = (list(h.tokens), ref)
+
     # ---- resilience gate 1: saves cost ONE sync each, nothing else ------
     import tempfile
     from paddle_tpu.resilience import (CheckpointManager,
@@ -942,12 +1016,33 @@ def run():
                            min_bucket=4, threaded=False,
                            warm_buckets=SERVE_LENS_WARM,
                            heartbeat_timeout_s=30.0)
-        b = counters.snapshot()
-        chs6 = [fl6.submit(rngh6.randint(0, 64, size=3).tolist(),
-                           max_new_tokens=6) for _ in range(4)]
-        fl6.join(chs6)       # clean leg on the same fleet: silence
-        hclean6 = {k: v for k, v in counters.delta(b).items()
-                   if k.startswith("health.alerts.fired.") and v}
+
+        def settle6(deadline_s=15.0):
+            """Tick until nothing is firing — a loaded CI box can push
+            nominal ITL over the CPU-scale burn target; once traffic
+            stops the windows drain and spurious alerts resolve.  The
+            router refuses shed=True admissions while critical, so the
+            next leg must not start until the plane is quiet."""
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < deadline_s:
+                fl6.health.maybe_tick()
+                if not fl6.health.firing():
+                    return True
+                time.sleep(0.05)
+            return False
+
+        # clean leg on the same fleet: silence.  Retried (re-baselined)
+        # on a box hiccup so the chaos expectation below stays exact.
+        for _ in range(3):
+            b = counters.snapshot()
+            chs6 = [fl6.submit(rngh6.randint(0, 64, size=3).tolist(),
+                               max_new_tokens=6) for _ in range(4)]
+            fl6.join(chs6)
+            hclean6 = {k: v for k, v in counters.delta(b).items()
+                       if k.startswith("health.alerts.fired.") and v}
+            if not hclean6:
+                break
+            settle6()
         if hclean6:
             violations["health-chaos:clean-leg"] = (hclean6, {})
         chs6 = [fl6.submit(rngh6.randint(0, 64, size=3).tolist(),
@@ -1189,6 +1284,9 @@ def run():
               "fleet_steady_delta": flsteady,
               "fleet_churn_delta": {k: v for k, v in chsteady.items()
                                     if k.startswith("serving.fleet.")},
+              "disagg_delta": {k: v for k, v in dsteady.items()
+                               if k.startswith(("serving.fleet.migrate.",
+                                                "serving.retraces"))},
               "ckpt_steady_delta": {k: v for k, v in csteady.items()
                                     if k.startswith(("jit.", "resilience."))},
               "fault_delta": {k: v for k, v in rsteady.items()

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# CI gate, in dependency order: TPU-hazard lint (fails on findings not in
+# the baseline), perf-trajectory regression check over the committed
+# BENCH_r0*.json history, then the steady-state counter invariants —
+# including the disagg phase (block-granular migration economics: copied
+# == owned non-shared blocks, prefix blocks never moved twice, zero
+# retraces across the prefill/decode split, token identity vs unified).
+#
+# Usage: scripts/ci_gate.sh        (from anywhere; cd's to the repo root)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== ci_gate: TPU-hazard lint (PT001-PT006, baseline-checked) =="
+python scripts/lint_tpu.py --check
+
+echo "== ci_gate: bench perf-trajectory regression =="
+# rc 2 means not enough parseable history (fresh clone / bootstrap run):
+# nothing to compare against is not a regression.
+rc=0
+python scripts/bench_compare.py --glob 'BENCH_r0*.json' || rc=$?
+if [ "$rc" -eq 1 ]; then
+    exit 1
+elif [ "$rc" -eq 2 ]; then
+    echo "(not enough bench history yet -- comparison skipped)"
+elif [ "$rc" -ne 0 ]; then
+    exit "$rc"
+fi
+
+echo "== ci_gate: steady-state counter invariants (incl. disagg) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
+    python scripts/check_counters.py
+
+echo "ci_gate: OK"

@@ -1,0 +1,268 @@
+"""scripts/bench_compare.py — the BENCH-trajectory perf-regression gate.
+
+Pure-python unit coverage (no model, no engine): metric classification,
+leg flattening, run extraction (including the legacy flagship schema),
+longest-suffix tolerance overrides, best-prior-per-(leg, metric)
+anchoring, and the CLI's 0 / 1 / 2 exit-status contract.
+"""
+
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+
+def _load():
+    path = (pathlib.Path(__file__).resolve().parent.parent / "scripts"
+            / "bench_compare.py")
+    spec = importlib.util.spec_from_file_location("bench_compare", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bc = _load()
+
+
+# -- classification ----------------------------------------------------------
+class TestClassify:
+    @pytest.mark.parametrize("metric", [
+        "tokens_per_sec", "mfu", "decode.tokens_per_sec",
+        "prefix.hit_rate", "spec.acceptance", "vs_baseline",
+        "capacity_ratio", "goodput.fraction",
+    ])
+    def test_throughput_like_must_not_drop(self, metric):
+        assert bc.classify(metric) == "higher"
+
+    @pytest.mark.parametrize("metric", [
+        "ttft.p95_ms", "itl.p95_ms", "queue_wait.mean",
+        "latency_ms", "step_time", "save_ms", "restore_s",
+        "decode.p99", "migrate.p50_ms",
+    ])
+    def test_latency_like_must_not_rise(self, metric):
+        assert bc.classify(metric) == "lower"
+
+    @pytest.mark.parametrize("metric", [
+        "count", "requests.count", "spread_frac", "n_params",
+        "some_unknown_metric",
+    ])
+    def test_informational_metrics_are_not_gated(self, metric):
+        assert bc.classify(metric) is None
+
+    def test_skip_beats_direction_keywords(self):
+        # the skip list is checked FIRST: a count of latency samples is
+        # not itself a latency
+        assert bc.classify("ttft.count") is None
+
+
+# -- flattening / extraction -------------------------------------------------
+class TestFlatten:
+    def test_nested_dotted_paths(self):
+        flat = bc._flatten({"ttft": {"p95_ms": 12.5, "count": 4},
+                            "tokens_per_sec": 100})
+        assert flat == {"ttft.p95_ms": 12.5, "ttft.count": 4.0,
+                        "tokens_per_sec": 100.0}
+
+    def test_bools_and_strings_are_skipped(self):
+        flat = bc._flatten({"ok": True, "name": "gpt", "v": 2})
+        assert flat == {"v": 2.0}
+
+
+class TestExtract:
+    def test_failed_run_is_skipped(self):
+        assert bc.extract({"rc": 1, "parsed": {"legs": {
+            "a": {"tokens_per_sec": 1}}}}) is None
+
+    def test_unparsed_run_is_skipped(self):
+        assert bc.extract({"rc": 0, "parsed": None}) is None
+        assert bc.extract({"rc": 0}) is None
+
+    def test_legs_schema(self):
+        legs = bc.extract({"rc": 0, "parsed": {"legs": {
+            "serve": {"ttft": {"p95_ms": 9.0}},
+            "train": {"tokens_per_sec": 50.0},
+            "bogus": 3}}})
+        assert legs == {"serve": {"ttft.p95_ms": 9.0},
+                        "train": {"tokens_per_sec": 50.0}}
+
+    def test_legacy_flagship_train_metric(self):
+        # "gpt125m_train_tokens_per_sec_per_chip" → leg gpt125m with
+        # tokens_per_sec, vs_baseline re-labelled mfu
+        legs = bc.extract({"rc": None, "parsed": {
+            "metric": "gpt125m_train_tokens_per_sec_per_chip",
+            "value": 123.0, "vs_baseline": 0.4}})
+        assert legs == {"gpt125m": {"tokens_per_sec": 123.0,
+                                    "mfu": 0.4}}
+
+    def test_legacy_nonmatching_metric_lands_on_flagship_leg(self):
+        legs = bc.extract({"rc": 0, "parsed": {
+            "metric": "serve_goodput", "value": 7.0,
+            "vs_baseline": 1.1}})
+        assert legs == {"_flagship": {"tokens_per_sec": 7.0,
+                                      "vs_baseline": 1.1}}
+
+    def test_empty_parse_is_none(self):
+        assert bc.extract({"rc": 0, "parsed": {"metric": "x"}}) is None
+
+
+# -- tolerance overrides -----------------------------------------------------
+class TestTolFor:
+    def test_default_when_no_override_matches(self):
+        assert bc.tol_for("ttft.p95_ms", 0.1, {"mfu": 0.05}) == 0.1
+
+    def test_exact_and_suffix_match(self):
+        ov = {"p95_ms": 0.25, "mfu": 0.05}
+        assert bc.tol_for("ttft.p95_ms", 0.1, ov) == 0.25
+        assert bc.tol_for("mfu", 0.1, ov) == 0.05
+
+    def test_longest_suffix_wins(self):
+        ov = {"p95_ms": 0.5, "ttft.p95_ms": 0.2}
+        assert bc.tol_for("serve.ttft.p95_ms", 0.1, ov) == 0.2
+
+
+# -- comparison --------------------------------------------------------------
+def _run(path, **legs):
+    return {"path": path, "n": None,
+            "legs": {leg: dict(m) for leg, m in legs.items()}}
+
+
+class TestCompare:
+    def test_anchors_on_best_prior_not_last(self):
+        """A slow decay across runs cannot hide: the candidate is held
+        to the trajectory's best (max for throughput, min for latency),
+        not the immediately previous run."""
+        history = [
+            _run("r1", serve={"tokens_per_sec": 100.0, "ttft.p95_ms": 5.0}),
+            _run("r2", serve={"tokens_per_sec": 80.0, "ttft.p95_ms": 9.0}),
+        ]
+        cand = _run("r3", serve={"tokens_per_sec": 85.0,
+                                 "ttft.p95_ms": 6.0})
+        regs, checks = bc.compare(history, cand, 0.1, {})
+        by = {(c["leg"], c["metric"]): c for c in checks}
+        assert by[("serve", "tokens_per_sec")]["best_prior"] == 100.0
+        assert by[("serve", "ttft.p95_ms")]["best_prior"] == 5.0
+        # 85 < 100*0.9 and 6 > 5*1.1: both regressed vs the BEST even
+        # though both beat r2
+        assert {(r["leg"], r["metric"]) for r in regs} == \
+            {("serve", "tokens_per_sec"), ("serve", "ttft.p95_ms")}
+
+    def test_within_tolerance_is_clean(self):
+        history = [_run("r1", serve={"tokens_per_sec": 100.0})]
+        cand = _run("r2", serve={"tokens_per_sec": 91.0})
+        regs, checks = bc.compare(history, cand, 0.1, {})
+        assert regs == [] and len(checks) == 1
+
+    def test_tol_for_override_applies(self):
+        history = [_run("r1", serve={"ttft.p95_ms": 10.0})]
+        cand = _run("r2", serve={"ttft.p95_ms": 12.0})
+        regs, _ = bc.compare(history, cand, 0.1, {})
+        assert len(regs) == 1
+        regs, _ = bc.compare(history, cand, 0.1, {"p95_ms": 0.25})
+        assert regs == []
+
+    def test_new_metric_without_prior_is_not_checked(self):
+        history = [_run("r1", serve={"tokens_per_sec": 100.0})]
+        cand = _run("r2", serve={"tokens_per_sec": 100.0},
+                    disagg={"itl.p95_ms": 3.0})
+        regs, checks = bc.compare(history, cand, 0.1, {})
+        assert regs == []
+        assert [(c["leg"], c["metric"]) for c in checks] == \
+            [("serve", "tokens_per_sec")]
+
+    def test_informational_metrics_never_regress(self):
+        history = [_run("r1", serve={"requests.count": 100.0})]
+        cand = _run("r2", serve={"requests.count": 1.0})
+        regs, checks = bc.compare(history, cand, 0.1, {})
+        assert regs == [] and checks == []
+
+
+# -- CLI exit-status contract ------------------------------------------------
+def _write(tmp_path, name, tps):
+    d = {"rc": 0, "parsed": {"legs": {"serve": {"tokens_per_sec": tps}}}}
+    p = tmp_path / name
+    p.write_text(json.dumps(d))
+    return p
+
+
+class TestMain:
+    def test_rc0_clean(self, tmp_path, capsys):
+        _write(tmp_path, "BENCH_r01.json", 100.0)
+        _write(tmp_path, "BENCH_r02.json", 105.0)
+        rc = bc.main(["--glob", str(tmp_path / "BENCH_r0*.json")])
+        assert rc == 0
+        assert "0 regression(s)" in capsys.readouterr().out
+
+    def test_rc1_regression(self, tmp_path, capsys):
+        _write(tmp_path, "BENCH_r01.json", 100.0)
+        _write(tmp_path, "BENCH_r02.json", 50.0)
+        rc = bc.main(["--glob", str(tmp_path / "BENCH_r0*.json")])
+        assert rc == 1
+        assert "REGRESSED" in capsys.readouterr().out
+
+    def test_rc1_json_report(self, tmp_path, capsys):
+        _write(tmp_path, "BENCH_r01.json", 100.0)
+        _write(tmp_path, "BENCH_r02.json", 50.0)
+        rc = bc.main(["--glob", str(tmp_path / "BENCH_r0*.json"),
+                      "--json"])
+        assert rc == 1
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["value"] == 1
+        assert rep["regressions"][0]["metric"] == "tokens_per_sec"
+
+    def test_rc2_not_enough_history(self, tmp_path):
+        _write(tmp_path, "BENCH_r01.json", 100.0)
+        rc = bc.main(["--glob", str(tmp_path / "BENCH_r0*.json")])
+        assert rc == 2
+
+    def test_rc2_unreadable_candidate(self, tmp_path):
+        _write(tmp_path, "BENCH_r01.json", 100.0)
+        rc = bc.main(["--glob", str(tmp_path / "BENCH_r0*.json"),
+                      "--candidate", str(tmp_path / "missing.json")])
+        assert rc == 2
+
+    def test_rc2_candidate_without_metrics(self, tmp_path):
+        _write(tmp_path, "BENCH_r01.json", 100.0)
+        bad = tmp_path / "cand.json"
+        bad.write_text(json.dumps({"rc": 1}))
+        rc = bc.main(["--glob", str(tmp_path / "BENCH_r0*.json"),
+                      "--candidate", str(bad)])
+        assert rc == 2
+
+    def test_explicit_candidate_excluded_from_prior(self, tmp_path):
+        """--candidate pointing INTO the history set: the candidate file
+        must not anchor itself (it would always compare clean)."""
+        _write(tmp_path, "BENCH_r01.json", 100.0)
+        cand = _write(tmp_path, "BENCH_r02.json", 50.0)
+        rc = bc.main(["--glob", str(tmp_path / "BENCH_r0*.json"),
+                      "--candidate", str(cand)])
+        assert rc == 1
+
+    def test_failed_runs_skipped_from_history(self, tmp_path):
+        """An rc!=0 bootstrap run neither anchors nor crashes the gate."""
+        bad = tmp_path / "BENCH_r01.json"
+        bad.write_text(json.dumps(
+            {"rc": 1, "parsed": {"legs": {"serve":
+                                          {"tokens_per_sec": 999.0}}}}))
+        _write(tmp_path, "BENCH_r02.json", 100.0)
+        _write(tmp_path, "BENCH_r03.json", 95.0)
+        rc = bc.main(["--glob", str(tmp_path / "BENCH_r0*.json")])
+        assert rc == 0
+
+    def test_tol_for_flag(self, tmp_path):
+        hist = {"rc": 0, "parsed": {"legs": {"serve":
+                                             {"ttft.p95_ms": 10.0}}}}
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(hist))
+        cand = {"rc": 0, "parsed": {"legs": {"serve":
+                                             {"ttft.p95_ms": 12.0}}}}
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(cand))
+        args = ["--glob", str(tmp_path / "BENCH_r0*.json")]
+        assert bc.main(args) == 1
+        assert bc.main(args + ["--tol-for", "p95_ms=0.3"]) == 0
+
+    def test_bad_tol_for_spec_errors(self, tmp_path):
+        _write(tmp_path, "BENCH_r01.json", 1.0)
+        _write(tmp_path, "BENCH_r02.json", 1.0)
+        with pytest.raises(SystemExit):
+            bc.main(["--glob", str(tmp_path / "BENCH_r0*.json"),
+                     "--tol-for", "nonsense"])

@@ -118,14 +118,17 @@ class TestPrefixCache:
         assert got == blocks[:2] and cached == 8
         assert pn is not None and pn.block == blocks[2] and p == 2
         assert pool.ref(blocks[0]) == 2             # retained for caller
+        assert pool.ref(pn.block) == 2              # partial retained too
         for b in got:
             pool.release(b)
+        pool.release(pn.block)
 
         # limit clips the partial
         got, cached, pn, p = cache.match(seq, limit=9)
         assert cached == 8 and p == 1
         for b in got:
             pool.release(b)
+        pool.release(pn.block)
 
         # divergent second block: only the first is shared
         div = seq[:4] + [63, 62, 61, 60]
@@ -133,6 +136,31 @@ class TestPrefixCache:
         assert got == blocks[:1] and cached == 4 and pn is None
         for b in got:
             pool.release(b)
+
+    def test_partial_survives_repeated_cow_matches(self):
+        """Regression: ``match`` retains the partial block for the
+        caller, so the COW-side release (``_reserve`` drops it after the
+        copy) does NOT strip the tree's own retain.  Without the
+        caller-side retain the first COW adoption freed the partial's
+        block under a live tree node — the next sharer matched a
+        dangling node over a freed (or reused) block and the release
+        blew up with "release of free block"."""
+        pool = BlockPool(9, 4)
+        cache = PrefixCache(pool)
+        seq = list(range(6))                        # 1 full block + 2 rest
+        blocks = pool.alloc_n(2)
+        cache.insert(seq, blocks)
+        for b in blocks:
+            pool.release(b)
+        for _ in range(3):                          # every sharer COWs
+            got, cached, pn, p = cache.match(seq, limit=5)
+            assert cached == 4 and pn is not None and p == 1
+            for b in got:
+                pool.release(b)                     # admission bookkeeping
+            pool.release(pn.block)                  # post-COW release
+            assert pool.ref(pn.block) == 1          # tree retain intact
+        cache.clear()
+        assert pool.free_blocks == pool.capacity
 
     def test_peek_is_read_only(self):
         pool = BlockPool(9, 4)
@@ -271,6 +299,30 @@ class TestPagedIdentity:
         st = eng.stats()
         assert st["cow_copies"] >= 1
         assert h2.tokens == _ref_generate(m, p2, 5)
+
+    def test_repeated_cow_adoptions_of_one_partial(self):
+        """Regression (engine level): several requests COW-adopting the
+        SAME cached partial, one after another.  Each adoption must
+        leave the tree's partial node alive over a still-referenced
+        block; pre-fix the first COW freed it and the next admission
+        crashed the engine on "release of free block"."""
+        m = _model()
+        rng = np.random.default_rng(8)
+        eng = _paged(m)
+        p1 = rng.integers(0, 64, size=10).tolist()
+        h1 = eng.add_request(p1, max_new_tokens=6, seed=2)
+        _run(eng, [h1])
+        seq1 = p1 + h1.tokens
+        for i in range(3):
+            p2 = seq1[:15] + rng.integers(0, 64, size=4).tolist()
+            h2 = eng.add_request(p2, max_new_tokens=4, seed=10 + i)
+            _run(eng, [h2])
+            assert h2.tokens == _ref_generate(m, p2, 4)
+        assert eng.stats()["cow_copies"] >= 3
+        pool = eng.pool
+        live = sum(1 for b in range(1, len(pool._ref))
+                   if pool._ref[b] > 0)
+        assert len(pool._free) + live == pool.capacity
 
 
 class TestChunkedPrefillInterleaving:
