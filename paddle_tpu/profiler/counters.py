@@ -76,6 +76,19 @@ Well-known names (see README "Observability" for the full table):
       (tokens quantized on insert into an int8/fp8 KV arena)
   serving.kv.quant.arena_bytes / serving.kv.quant.bytes_saved (gauges:
       quantized arena+scales footprint, and savings vs the model dtype)
+  serving.kv.tier.spilled_blocks / serving.kv.tier.restored_blocks
+      (host-RAM KV tier traffic: device blocks demoted to pinned host
+      buffers, and host entries paged back into the arena)
+  serving.kv.tier.spill_drops (host copies discarded: tier LRU
+      overflow, request teardown while spilled, or the kv_spill_drop
+      fault; the affected tokens replay by deterministic re-prefill)
+  serving.kv.tier.readopted (host-resident prefix nodes flipped back to
+      device residency for free because a donor carried a live copy)
+  serving.kv.tier.host_blocks (gauge: tier entries currently resident)
+  serving.kv.host_arena_bytes (gauge: total pinned host bytes ever
+      allocated for the tier — flat once the reuse pool is warm)
+  serving.kv.host_buf_reuse (spill/restore buffers served from the
+      reuse pool instead of a fresh allocation)
   serving.spec.drafted / serving.spec.accepted / serving.spec.rejected
       (speculative decoding proposal outcomes; accepted + rejected ==
       drafted, every scheduler round)

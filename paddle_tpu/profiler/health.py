@@ -349,6 +349,35 @@ def _wd_spec_acceptance(w, monitor):
     return firing, {"drafted": drafted, "acceptance": acc}
 
 
+def _wd_kv_spill_burn(w, monitor):
+    """Sustained host-tier spill traffic: the device pool is
+    oversubscribed enough that cold-block demotion runs on the admission
+    path every window.  Needs real volume (>= 8 blocks) AND a sustained
+    rate (> 1 block/s) before firing, so a one-off burst when a big
+    prompt lands does not flap; the autoscaler answers with
+    ``grow_decode`` (more HBM beats paging churn)."""
+    spilled = w.delta("serving.kv.tier.spilled_blocks")
+    rate = w.rate("serving.kv.tier.spilled_blocks")
+    return (spilled >= 8 and rate > 1.0), {"spilled": spilled,
+                                           "rate": rate,
+                                           "window_s": w.seconds}
+
+
+def _wd_kv_tier_occupancy(w, monitor):
+    """Host tier nearly full (>= 90% of capacity on any engine): the
+    next spills will LRU-discard resident entries, turning demotions
+    into data loss (replay-by-prefill).  Live early warning that the
+    tier itself needs resizing."""
+    for eng in monitor._pools():
+        tier = getattr(eng, "_host_tier", None)
+        if tier is None:
+            continue
+        if tier.resident >= 0.9 * tier.capacity:
+            return True, {"resident": tier.resident,
+                          "capacity": tier.capacity}
+    return False, {}
+
+
 def _wd_prefetch_stall(w, monitor):
     """Input pipeline starvation: time blocked on data dominates the
     window."""
@@ -384,6 +413,8 @@ def default_watchdogs():
         Watchdog("kv_conservation", _wd_kv_conservation,
                  severity="critical"),
         Watchdog("kv_backpressure", _wd_kv_backpressure),
+        Watchdog("kv_spill_burn", _wd_kv_spill_burn),
+        Watchdog("kv_tier_occupancy", _wd_kv_tier_occupancy),
         Watchdog("goodput_accounted", _wd_goodput_accounted),
         Watchdog("spec_acceptance", _wd_spec_acceptance),
         Watchdog("prefetch_stall", _wd_prefetch_stall),

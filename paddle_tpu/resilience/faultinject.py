@@ -66,6 +66,16 @@ Well-known sites
                      tree, the destination never allocated) and replay
                      the request by deterministic re-prefill with token
                      identity.
+``kv_spill_drop``    drops a spilled block's host-tier copy mid-restore;
+                     index = request id (engine rid for prefix-chain
+                     restores at admission, fleet request id for
+                     idle-spilled exports).  Both tiers must reconcile —
+                     host buffers recycle, no device block is ever
+                     allocated for the lost data — and the request
+                     replays by deterministic re-prefill: a dropped
+                     prefix chain becomes a plain cache miss (queried
+                     via :func:`take`), a dropped request spill raises
+                     ``HostTierLost`` so the fleet requeues it.
 ``slow_decode``      per-iteration stall of the replica decoding fleet
                      request ``index``: the replica sleeps
                      ``fleet.SLOW_DECODE_STALL_S`` before its decode
@@ -133,6 +143,7 @@ _EXC = {
     "router_queue": InjectedFault,
     "kv_pool_exhausted": InjectedFault,   # consumed via take(); never raised
     "kv_migrate_drop": InjectedFault,
+    "kv_spill_drop": InjectedFault,       # consumed via take(); never raised
     "slow_decode": InjectedFault,         # consumed via take(); never raised
 }
 
@@ -250,7 +261,8 @@ _flags.define_flag(
     "Deterministic fault-injection schedule for resilience testing: "
     "'site@index[*count];...' with sites ckpt_write/ckpt_crash/preempt/"
     "loader/nan_loss/serving_prefill/replica_crash/decode_stall/"
-    "slow_decode/router_queue/kv_pool_exhausted/kv_migrate_drop (see "
-    "paddle_tpu.resilience.faultinject).  Empty disables injection.")
+    "slow_decode/router_queue/kv_pool_exhausted/kv_migrate_drop/"
+    "kv_spill_drop (see paddle_tpu.resilience.faultinject).  Empty "
+    "disables injection.")
 _flags.register_flag_observer("FLAGS_fault_schedule",
                               lambda v: set_schedule(v or None))

@@ -18,6 +18,11 @@ the :class:`serving.fleet.ServingFleet`:
 * ``ttft_burn`` / ``queue_wait_burn`` firing → ``grow_prefill``: the
   admission side is starved — flip a surplus decode replica to prefill,
   else spawn one.
+* ``kv_spill_burn`` firing → ``grow_decode``: sustained host-tier spill
+  traffic means device KV is oversubscribed and the fleet is paying
+  paging churn on the hot path — more decode HBM is cheaper than the
+  spill/restore treadmill.  On a unified fleet it disaggregates first
+  (same capacity math: the split frees decode-side arena).
 * a clean streak of ``ok_streak`` evaluations → ``retire``: shrink back
   by retiring an **idle, self-spawned** replica (the autoscaler never
   retires replicas it did not create — fleet sizing is the operator's
@@ -110,6 +115,12 @@ class FleetAutoscaler:
                       if disagg else self._disaggregate(alive))
         elif "ttft_burn" in firing or "queue_wait_burn" in firing:
             action = (self._grow("prefill", prefill, decode, alive)
+                      if disagg else self._disaggregate(alive))
+        elif "kv_spill_burn" in firing:
+            # sustained spill-rate burn: device KV is oversubscribed and
+            # paging churn is on the admission path — decode HBM is the
+            # cheaper fix
+            action = (self._grow("decode", prefill, decode, alive)
                       if disagg else self._disaggregate(alive))
         if action is None and not firing:
             self._ok += 1

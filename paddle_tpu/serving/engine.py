@@ -205,7 +205,8 @@ class LLMEngine:
     def __init__(self, model, max_slots=8, max_seq_len=None, queue_size=64,
                  min_bucket=8, eos_token_id=None, kv_layout="slots",
                  block_size=16, n_blocks=None, prefill_chunk=None,
-                 prefix_cache=True, kv_dtype=None, weight_dtype=None):
+                 prefix_cache=True, kv_dtype=None, weight_dtype=None,
+                 host_kv_blocks=0, spill_idle_steps=0):
         if kv_layout not in ("slots", "paged"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}; "
                              "want 'slots' or 'paged'")
@@ -227,6 +228,9 @@ class LLMEngine:
         self.prefix_caching = bool(prefix_cache)
         self.kv_dtype = kv_dtype
         self.weight_dtype = weight_dtype
+        # host-RAM KV tier knobs (paged engine only; 0 disables)
+        self.host_kv_blocks = int(host_kv_blocks or 0)
+        self.spill_idle_steps = int(spill_idle_steps or 0)
         c = model.config
         self.model = model
         self.config = c
@@ -337,6 +341,13 @@ class LLMEngine:
         the paged engine.  The Router uses this for prefix-hit-aware
         dispatch."""
         return 0
+
+    def prefix_probe(self, prompt):
+        """``(device_tokens, host_tokens)`` a prefix cache could serve —
+        ``(0, 0)`` under the slot layout; the paged engine overrides.
+        The Router's cost model discounts the host component by the
+        restore price (see ``serving.router``)."""
+        return 0, 0
 
     # -- compiled programs ---------------------------------------------------
     @staticmethod

@@ -82,7 +82,7 @@ from ..resilience import faultinject
 from .autoscale import FleetAutoscaler
 from .engine import (EngineBackpressure, EngineClosed, LLMEngine,
                      bucket_length)
-from .kvcache import BlockPoolExhausted
+from .kvcache import BlockPoolExhausted, HostTierLost
 from .router import RetryAfter, Router
 
 __all__ = ["FleetRequest", "Replica", "ServingFleet"]
@@ -268,7 +268,8 @@ class ServingFleet:
                  prefill_chunk=None, prefix_cache=True, kv_dtype=None,
                  weight_dtype=None, draft_model=None, spec_k=4,
                  prefill_replicas=0, autoscale=False, autoscale_kw=None,
-                 health_kw=None):
+                 health_kw=None, host_kv_blocks=0, spill_idle_steps=0,
+                 restore_cost=0.5):
         self.model = model
         prefill_replicas = int(prefill_replicas)
         if prefill_replicas:
@@ -289,14 +290,17 @@ class ServingFleet:
                                prefill_chunk=prefill_chunk,
                                prefix_cache=prefix_cache,
                                kv_dtype=kv_dtype,
-                               weight_dtype=weight_dtype)
+                               weight_dtype=weight_dtype,
+                               host_kv_blocks=host_kv_blocks,
+                               spill_idle_steps=spill_idle_steps)
         if draft_model is not None:
             # every replica runs draft/verify speculative decoding; the
             # compiled draft + verify programs are shared fleet-wide
             # through the per-model program registry
             self._engine_kw.update(draft_model=draft_model,
                                    spec_k=spec_k)
-        self.router = router if router is not None else Router(slo_margin)
+        self.router = (router if router is not None
+                       else Router(slo_margin, restore_cost=restore_cost))
         # the health plane: construction is free; every tick is gated on
         # FLAGS_health inside maybe_tick().  The router shares the
         # monitor so Router.stats()["health"] serves the same view.
@@ -759,6 +763,22 @@ class ServingFleet:
         t0_tr = time.perf_counter_ns()
         try:
             mig = eng.export_request(er)
+        except HostTierLost as e:
+            # the idle-spilled KV's host copy is gone (kv_spill_drop
+            # fault or tier overflow): replay from scratch — same id,
+            # same seed, token-identical output
+            self._abort_migration(freq, src, er, "dropped", e)
+            return
+        except EngineBackpressure as e:
+            # the source pool cannot host the page-in right now: the KV
+            # stays split across tiers (partial restores kept) and the
+            # hand-off retries from the source's scheduler loop
+            counters.inc("serving.fleet.migrate.deferred")
+            if freq.trace is not None:
+                freq.trace.add_event("migrate_deferred", error=repr(e))
+            with self._lock:
+                self._held_migrations.append((freq, src, er))
+            return
         except RuntimeError:
             return    # finished/evicted between emit and absorb: not held
         try:
@@ -1098,6 +1118,16 @@ class ServingFleet:
                                       for st in paged),
                 "pool_exhausted": sum(st["pool_exhausted"]
                                       for st in paged),
+                "host_tier_capacity": sum(st.get("host_tier_capacity", 0)
+                                          for st in paged),
+                "host_tier_blocks": sum(st.get("host_tier_blocks", 0)
+                                        for st in paged),
+                "host_arena_bytes": sum(st.get("host_arena_bytes", 0)
+                                        for st in paged),
+                "tier_spilled": sum(st.get("tier_spilled", 0)
+                                    for st in paged),
+                "tier_restored": sum(st.get("tier_restored", 0)
+                                     for st in paged),
             }
         spec = [st for st in reps
                 if st.get("speculative") and st["alive"]]

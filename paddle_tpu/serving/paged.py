@@ -24,6 +24,15 @@ logical block index to a physical pool block.  Three consequences:
   (``prefill_chunk`` knob), one chunk per scheduler step, interleaved
   with the decode launch, so a long prompt can never starve another
   user's inter-token latency.
+* **Host-RAM tiering** — with ``host_kv_blocks > 0``, cold blocks spill
+  to a pinned host arena instead of vanishing: LRU prefix-tree leaves
+  move under device pressure (spill-before-evict) and held requests
+  idle past ``spill_idle_steps`` park their private KV host-side until
+  migration pages it back.  Spill is one fixed-shape block gather,
+  restore one fixed-shape donated scatter — two more programs compiled
+  once, zero steady-state retraces — and buffers come from a reuse pool
+  so the steady state never mallocs.  The payoff is graceful throughput
+  degradation instead of shedding at 2–4× oversubscribed KV.
 
 TPU discipline is unchanged from the slot engine: block tables ride the
 compiled programs as int32 OPERANDS (never shape inputs), so steady
@@ -51,7 +60,8 @@ from ..profiler import trace as rtrace
 from ..profiler.host_tracer import span
 from .engine import (EngineBackpressure, EngineClosed, LLMEngine, Request,
                      _model_programs, bucket_length)
-from .kvcache import (BlockPool, BlockPoolExhausted, PrefixCache,
+from .kvcache import (TRASH_BLOCK, BlockPool, BlockPoolExhausted,
+                      HostKVTier, HostTierLost, PrefixCache,
                       blocks_for_tokens)
 
 __all__ = ["PagedLLMEngine"]
@@ -70,6 +80,11 @@ class PagedLLMEngine(LLMEngine):
       (default ``min(S_max, 128)``); chunk programs are bucketed
       powers-of-two up to this, like the slot engine's prefill buckets.
     * ``prefix_cache`` — enable the COW prefix tree (default True).
+    * ``host_kv_blocks`` — host-RAM tier capacity in blocks (default 0:
+      tier disabled).  Requires the prefix cache.
+    * ``spill_idle_steps`` — scheduler steps a held request sits idle
+      before its private KV spills to the host tier (default 0: held
+      requests never spill).
     """
 
     # -- construction hooks --------------------------------------------------
@@ -124,6 +139,23 @@ class PagedLLMEngine(LLMEngine):
         self._pdecode_jit = None
         self._pcopy_jit = None
         self._pmigrate_jit = None
+        self._pspill_jit = None
+        self._prestore_jit = None
+        # host-RAM KV tier: cold prefix leaves and idle held requests
+        # spill their blocks into pinned host buffers and page back on
+        # demand (requires the prefix tree — its nodes key the entries)
+        self._host_tier = (HostKVTier(self.host_kv_blocks)
+                           if self.host_kv_blocks > 0
+                           and self.prefix is not None else None)
+        if self.prefix is not None:
+            self.prefix.tier = self._host_tier
+        # one host buffer spec per block: K/V tiles (+ scale rows)
+        spec = [((c.num_layers, bs, nh, hd), np.dtype(adt))] * 2
+        if self.kv_dtype:
+            spec += [((c.num_layers, bs), np.dtype(np.float32))] * 2
+        self._host_spec = tuple(spec)
+        self._req_host = {}    # rid -> {"idx": set[int], "lost": bool}
+        self._held_idle = {}   # rid -> idle scheduler steps while held
         # per-engine prefix-cache accounting (the fleet sums these; the
         # same events also feed the process-global counters registry)
         self.kv_prefix_hits = 0
@@ -132,6 +164,8 @@ class PagedLLMEngine(LLMEngine):
         self.kv_cow_copies = 0
         self.kv_blocks_evicted = 0
         self.kv_pool_exhausted_events = 0
+        self.kv_tier_spilled = 0
+        self.kv_tier_restored = 0
 
     def release_kv(self):
         self._pk = self._pv = self._sk = self._sv = None
@@ -144,6 +178,20 @@ class PagedLLMEngine(LLMEngine):
             dtype=np.int32).reshape(-1)
         with self._cond:
             return self.prefix.peek(ids.tolist(), int(ids.shape[0]) - 1)
+
+    def prefix_probe(self, prompt):
+        """``(device_tokens, host_tokens)`` the prefix cache could serve
+        for this prompt — the router's restore-aware dispatch score
+        (device hits are free; host hits pay a page-in first, so the
+        cost model discounts them).  Cheap on misses: the radix digest
+        short-circuits the walk (see ``PrefixCache.probe``)."""
+        if self.prefix is None:
+            return 0, 0
+        ids = np.asarray(
+            prompt._data if hasattr(prompt, "_data") else prompt,
+            dtype=np.int32).reshape(-1)
+        with self._cond:
+            return self.prefix.probe(ids.tolist(), int(ids.shape[0]) - 1)
 
     # -- compiled programs ---------------------------------------------------
     # The jitted callables live in the per-model cache shared by every
@@ -352,6 +400,298 @@ class PagedLLMEngine(LLMEngine):
             self._pmigrate_jit = fn
         return self._pmigrate_jit
 
+    def _pspill(self):
+        """Host-tier spill gather: slice ONE block's K/V tiles (+ scale
+        rows under quantized arenas) out of the arena in one fixed-shape
+        dispatch.  Nothing is donated — the arena keeps serving; the
+        caller materializes the result into pinned host buffers and only
+        then releases the device block."""
+        if self._pspill_jit is None:
+            progs = _model_programs(self.model)
+            key = self._prog_key("spill_block")
+            fn = progs.get(key)
+            if fn is None:
+                if self.kv_dtype:
+                    def spill(pk, pv, sk, sv, b):
+                        counters.inc("serving.retraces")  # trace-time only
+                        kb = jax.lax.dynamic_slice_in_dim(
+                            pk, b, 1, axis=1)[:, 0]
+                        vb = jax.lax.dynamic_slice_in_dim(
+                            pv, b, 1, axis=1)[:, 0]
+                        skb = jax.lax.dynamic_slice_in_dim(
+                            sk, b, 1, axis=1)[:, 0]
+                        svb = jax.lax.dynamic_slice_in_dim(
+                            sv, b, 1, axis=1)[:, 0]
+                        return kb, vb, skb, svb
+                else:
+                    def spill(pk, pv, b):
+                        counters.inc("serving.retraces")  # trace-time only
+                        kb = jax.lax.dynamic_slice_in_dim(
+                            pk, b, 1, axis=1)[:, 0]
+                        vb = jax.lax.dynamic_slice_in_dim(
+                            pv, b, 1, axis=1)[:, 0]
+                        return kb, vb
+                fn = jax.jit(spill)
+                progs[key] = fn
+            self._pspill_jit = fn
+        return self._pspill_jit
+
+    def _prestore(self):
+        """Host-tier restore scatter: write ONE block's host-side K/V
+        tiles (+ scale rows) into a freshly allocated arena block, one
+        fixed-shape donated dispatch — the exact inverse of
+        :meth:`_pspill`, same shape family as the COW clone."""
+        if self._prestore_jit is None:
+            progs = _model_programs(self.model)
+            key = self._prog_key("restore_block")
+            fn = progs.get(key)
+            if fn is None:
+                if self.kv_dtype:
+                    def restore(pk, pv, sk, sv, kb, vb, skb, svb, b):
+                        counters.inc("serving.retraces")  # trace-time only
+                        pk = jax.lax.dynamic_update_slice(
+                            pk, kb[:, None], (0, b, 0, 0, 0))
+                        pv = jax.lax.dynamic_update_slice(
+                            pv, vb[:, None], (0, b, 0, 0, 0))
+                        sk = jax.lax.dynamic_update_slice(
+                            sk, skb[:, None], (0, b, 0))
+                        sv = jax.lax.dynamic_update_slice(
+                            sv, svb[:, None], (0, b, 0))
+                        return pk, pv, sk, sv
+                    fn = jax.jit(restore, donate_argnums=(0, 1, 2, 3))
+                else:
+                    def restore(pk, pv, kb, vb, b):
+                        counters.inc("serving.retraces")  # trace-time only
+                        pk = jax.lax.dynamic_update_slice(
+                            pk, kb[:, None], (0, b, 0, 0, 0))
+                        pv = jax.lax.dynamic_update_slice(
+                            pv, vb[:, None], (0, b, 0, 0, 0))
+                        return pk, pv
+                    fn = jax.jit(restore, donate_argnums=(0, 1))
+                progs[key] = fn
+            self._prestore_jit = fn
+        return self._prestore_jit
+
+    # -- host-RAM KV tier ----------------------------------------------------
+    # All helpers below run with ``_cond`` held by the caller: spill and
+    # restore are part of atomic reservation / export transitions, same
+    # contract as the COW and migration adopts.  Each is a bounded
+    # number of one-block dispatches, never a per-token loop.
+    def _spill_block(self, block):
+        """Device→host copy of ONE block into reuse-pool buffers
+        (returned).  ``np.asarray`` materializes the gather before the
+        copy, so the device block is reusable the moment this
+        returns."""
+        sp = self._pspill()
+        if self.kv_dtype:
+            out = sp(self._pk, self._pv, self._sk, self._sv,
+                     np.int32(block))
+        else:
+            out = sp(self._pk, self._pv, np.int32(block))
+        bufs = self._host_tier.acquire(self._host_spec)
+        for dst, src in zip(bufs, out):
+            np.copyto(dst, np.asarray(src))
+        return bufs
+
+    def _restore_block(self, block, bufs):
+        """Host→device scatter of one tier entry into ``block``.  The
+        numpy buffers ride the dispatch as operands and may be aliased
+        by the backend (CPU jax aliases host arrays zero-copy): callers
+        must sync (``jax.block_until_ready``) before recycling them."""
+        rs = self._prestore()
+        if self.kv_dtype:
+            (self._pk, self._pv, self._sk, self._sv) = rs(
+                self._pk, self._pv, self._sk, self._sv, *bufs,
+                np.int32(block))
+        else:
+            self._pk, self._pv = rs(self._pk, self._pv, *bufs,
+                                    np.int32(block))
+
+    def _drop_host_key(self, key):
+        """Reconcile bookkeeping for a key the tier LRU-discarded: a
+        prefix node drops its (all-host) subtree; a spilled-request
+        shard marks the request's spill set lost, so export replays it
+        by re-prefill instead of restoring."""
+        if isinstance(key, tuple) and key and key[0] == "req":
+            ent = self._req_host.get(key[1])
+            if ent is not None:
+                ent["idx"].discard(key[2])
+                ent["lost"] = True
+            counters.inc("serving.kv.tier.spill_drops")
+        else:
+            self.prefix.drop_host(key)
+
+    def _spill_cold(self, want):
+        """Spill up to ``want`` cold prefix-tree blocks to the host
+        tier, coldest first, freeing their device blocks.  Runs BEFORE
+        LRU eviction on shortfall, so oversubscription demotes prefixes
+        instead of destroying them.  Returns blocks freed."""
+        freed = 0
+        while freed < want:
+            victims = self.prefix.spill_victims(want - freed)
+            if not victims:
+                break
+            for v in victims:
+                bufs = self._spill_block(v.block)
+                self.prefix.mark_spilled(v)
+                self.kv_tier_spilled += 1
+                for k in self._host_tier.put(v, bufs):
+                    self._drop_host_key(k)
+                freed += 1
+        return freed
+
+    def _restore_prefix(self, tokens, limit, rid):
+        """Page the host-resident chain extending this prompt's device
+        match back into fresh device blocks, so the subsequent
+        ``PrefixCache.match`` adopts them like any cached prefix.
+        Under the ``kv_spill_drop`` fault the chain's host copies are
+        dropped instead — the prompt becomes a plain miss and the
+        fresh prefill IS the deterministic replay.  Returns blocks
+        restored."""
+        from ..resilience import faultinject as _fi
+        chain = self.prefix.host_chain(tokens, limit)
+        if not chain:
+            return 0
+        if _fi.take("kv_spill_drop", rid):
+            dropped = self.prefix.drop_host(chain[0])
+            flight.record("serving.kv.tier.spill_drop", rid=rid,
+                          nodes=dropped, where="prefix_restore")
+            return 0
+        restored = []
+        for node in chain:
+            bufs = self._host_tier.get(node)
+            if bufs is None:
+                # overflow discarded the entry between walk and get:
+                # the rest of the chain is a miss now
+                self.prefix.drop_host(node)
+                break
+            if self.pool.free_blocks == 0:
+                self.prefix.evict(1)
+                if self.pool.free_blocks == 0:
+                    break
+            block = self.pool.alloc()
+            self._restore_block(block, bufs)
+            self.prefix.mark_restored(node, block)
+            self.kv_tier_restored += 1
+            restored.append(node)
+        if restored:
+            # the restore scatters may alias the tier buffers on CPU
+            # backends — one sync for the whole chain, then recycle
+            jax.block_until_ready(self._pk)
+            for node in restored:
+                self._host_tier.pop(node)
+        return len(restored)
+
+    def _maybe_spill_idle(self):
+        """Held (disaggregation hand-off) requests that sit idle past
+        ``spill_idle_steps`` scheduler steps spill their private KV to
+        the host tier; ``export_request`` pages it back before
+        snapshotting.  One sweep per :meth:`step`."""
+        if self._host_tier is None or self.spill_idle_steps <= 0:
+            return
+        with self._cond:
+            live = {r.rid: (s, r) for s, r in enumerate(self._slots)
+                    if r is not None and r.state == "held"
+                    and r.rid not in self._req_host}
+            self._held_idle = {rid: self._held_idle.get(rid, 0) + 1
+                               for rid in live}
+            for rid, steps in list(self._held_idle.items()):
+                if steps >= self.spill_idle_steps:
+                    slot, req = live[rid]
+                    self._spill_request(slot, req)
+                    del self._held_idle[rid]
+
+    def _spill_request(self, slot, req):
+        """Move a held request's PRIVATE data blocks (refcount 1, below
+        the write frontier) to the host tier and trash their table
+        entries; shared prefix blocks stay device-side.  The freed
+        blocks fund new admissions while the request waits for its
+        decode-replica migration.  Caller holds ``_cond``."""
+        table = self._slot_blocks[slot]
+        pos = int(self._pos[slot])
+        n_data = blocks_for_tokens(max(pos, 1), self.pool.block_size)
+        ent = {"idx": set(), "lost": False}
+        for i in range(n_data):
+            b = table[i]
+            if b == TRASH_BLOCK or self.pool.ref(b) != 1:
+                continue
+            bufs = self._spill_block(b)
+            for k in self._host_tier.put(("req", req.rid, i), bufs):
+                self._drop_host_key(k)
+            self.pool.release(b)
+            table[i] = TRASH_BLOCK
+            self._bt[slot, i] = 0
+            ent["idx"].add(i)
+            counters.inc("serving.kv.tier.spilled_blocks")
+            self.kv_tier_spilled += 1
+        if ent["idx"]:
+            self._req_host[req.rid] = ent
+            flight.record("serving.kv.tier.req_spilled", rid=req.rid,
+                          blocks=len(ent["idx"]))
+
+    def _restore_request(self, req):
+        """Page a spilled held request's KV back into fresh device
+        blocks so :meth:`export_request` can snapshot a fully
+        device-resident table.  Raises :class:`HostTierLost` when the
+        host copy is gone (tier overflow or the ``kv_spill_drop``
+        fault) — the fleet requeues the request for deterministic
+        replay — and ``EngineBackpressure`` when the pool cannot host
+        the restore yet (partial progress is kept; the deferred export
+        resumes where it stopped).  Caller holds ``_cond``."""
+        from ..resilience import faultinject as _fi
+        ent = self._req_host.get(req.rid)
+        if ent is None:
+            return
+        slot = req.slot
+        table = self._slot_blocks[slot]
+        if ent["lost"] or _fi.take("kv_spill_drop", req.rid):
+            for i in list(ent["idx"]):
+                self._host_tier.pop(("req", req.rid, i))
+                counters.inc("serving.kv.tier.spill_drops")
+            del self._req_host[req.rid]
+            flight.record("serving.kv.tier.spill_drop", rid=req.rid,
+                          nodes=len(table), where="request_restore")
+            raise HostTierLost(
+                f"request {req.rid}: spilled KV lost before restore")
+        restored, err = [], None
+        for i in sorted(ent["idx"]):
+            bufs = self._host_tier.get(("req", req.rid, i))
+            if bufs is None:
+                ent["lost"] = True
+                break
+            if self.pool.free_blocks == 0 and self.prefix is not None:
+                self.prefix.evict(1)
+            if self.pool.free_blocks == 0:
+                err = EngineBackpressure(
+                    "host-tier restore needs free blocks",
+                    queue_depth=len(self._queue),
+                    retry_after_hint=self._retry_hint_locked())
+                break
+            b = self.pool.alloc()
+            self._restore_block(b, bufs)
+            table[i] = b
+            self._bt[slot, i] = b
+            restored.append(i)
+        if restored:
+            jax.block_until_ready(self._pk)
+            for i in restored:
+                ent["idx"].discard(i)
+                self._host_tier.pop(("req", req.rid, i))
+            counters.inc("serving.kv.tier.restored_blocks", len(restored))
+            self.kv_tier_restored += len(restored)
+        if ent["lost"]:
+            for i in list(ent["idx"]):
+                self._host_tier.pop(("req", req.rid, i))
+                counters.inc("serving.kv.tier.spill_drops")
+            del self._req_host[req.rid]
+            raise HostTierLost(
+                f"request {req.rid}: spilled KV lost mid-restore")
+        if err is not None:
+            raise err
+        del self._req_host[req.rid]
+        flight.record("serving.kv.tier.req_restored", rid=req.rid,
+                      blocks=len(restored))
+
     # -- request intake ------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, **kw):
         ids = np.asarray(
@@ -385,13 +725,24 @@ class PagedLLMEngine(LLMEngine):
             injected = _fi.take("kv_pool_exhausted", req.rid)
             shared, cached, pnode, p = [], 0, None, 0
             if self.prefix is not None and not injected:
+                if self._host_tier is not None:
+                    # page host-resident prefix blocks back in first so
+                    # the match below adopts them like any cached prefix
+                    self._restore_prefix(req.prompt.tolist(), T - 1,
+                                         req.rid)
                 shared, cached, pnode, p = self.prefix.match(
                     req.prompt.tolist(), T - 1)
             fresh_needed = total - len(shared)
             shortfall = fresh_needed - self.pool.free_blocks
             if shortfall > 0 and self.prefix is not None:
-                self.kv_blocks_evicted += self.prefix.evict(shortfall)
-                shortfall = fresh_needed - self.pool.free_blocks
+                if self._host_tier is not None:
+                    # spill-before-evict: demote cold prefixes to host
+                    # RAM instead of destroying them
+                    self._spill_cold(shortfall)
+                    shortfall = fresh_needed - self.pool.free_blocks
+                if shortfall > 0:
+                    self.kv_blocks_evicted += self.prefix.evict(shortfall)
+                    shortfall = fresh_needed - self.pool.free_blocks
             if injected or shortfall > 0:
                 for b in shared:
                     self.pool.release(b)
@@ -665,6 +1016,11 @@ class PagedLLMEngine(LLMEngine):
                 raise RuntimeError(
                     f"request {req.rid} is not held for migration "
                     f"(state={req.state!r})")
+            if self._host_tier is not None:
+                # an idle-spilled request pages its KV back before the
+                # snapshot (raises HostTierLost / EngineBackpressure —
+                # the fleet replays or defers, nothing is torn here)
+                self._restore_request(req)
             return {
                 "prompt": req.prompt,
                 "tokens": list(req.tokens),
@@ -730,6 +1086,12 @@ class PagedLLMEngine(LLMEngine):
                     retry_after_hint=self._retry_hint_locked())
             shared, cached = [], 0
             if self.prefix is not None:
+                if self._host_tier is not None:
+                    # a host-resident prefix counts as "held here" for
+                    # the router's cost model — page it in so the
+                    # match below shares it instead of copying
+                    self._restore_prefix(seq.tolist(), (pos // bs) * bs,
+                                         -1)
                 # only whole blocks strictly below the write frontier are
                 # shareable: the block holding position ``pos`` will be
                 # written by the next decode step and must stay private
@@ -739,8 +1101,12 @@ class PagedLLMEngine(LLMEngine):
             fresh_needed = total - n_shared
             shortfall = fresh_needed - self.pool.free_blocks
             if shortfall > 0 and self.prefix is not None:
-                self.kv_blocks_evicted += self.prefix.evict(shortfall)
-                shortfall = fresh_needed - self.pool.free_blocks
+                if self._host_tier is not None:
+                    self._spill_cold(shortfall)
+                    shortfall = fresh_needed - self.pool.free_blocks
+                if shortfall > 0:
+                    self.kv_blocks_evicted += self.prefix.evict(shortfall)
+                    shortfall = fresh_needed - self.pool.free_blocks
             if shortfall > 0:
                 for b in shared:
                     self.pool.release(b)
@@ -821,6 +1187,14 @@ class PagedLLMEngine(LLMEngine):
             self._outstanding += max(
                 0, req.max_new_tokens - len(req.tokens))
             self._adopt_extra(slot, req, mig)
+            if self.prefix is not None and pos // bs > 0:
+                # migrated prefixes re-enter THIS tree immediately: the
+                # blocks below the write frontier are never mutated, so
+                # the next same-prefix prompt or migration shares them
+                # without waiting for this request to finish and donate
+                n_full = pos // bs
+                self.prefix.insert(seq[:n_full * bs].tolist(),
+                                   table[:n_full])
         info = {"blocks_copied": n_copy, "blocks_shared": n_shared,
                 "tokens": pos, "blocks_total": total}
         if trace_ctx is not None:
@@ -857,18 +1231,29 @@ class PagedLLMEngine(LLMEngine):
         st = self._prefill_state.pop(slot, None)
         self._running[slot] = False
         self._bt[slot] = 0
+        self._held_idle.pop(req.rid, None)
+        ent = self._req_host.pop(req.rid, None)
+        if ent is not None:
+            # released while spilled (cancel / abandoned migration):
+            # the host copies die with the request
+            for i in ent["idx"]:
+                if self._host_tier.pop(("req", req.rid, i)):
+                    counters.inc("serving.kv.tier.spill_drops")
         if table is None:
             return
         if self.prefix is not None and st is None and reason != "error" \
-                and req.tokens:
+                and req.tokens and TRASH_BLOCK not in table:
             # K/V is live through position T + len(tokens) - 2 (the last
-            # emitted token was sampled but never written back)
+            # emitted token was sampled but never written back); a table
+            # with trashed (spilled-and-not-restored) entries has holes
+            # and cannot donate
             n_avail = int(req.prompt.shape[0]) + len(req.tokens) - 1
             seq = np.concatenate(
                 [req.prompt, np.asarray(req.tokens, np.int32)])[:n_avail]
             self.prefix.insert(seq.tolist(), table)
         for b in table:
-            self.pool.release(b)
+            if b != TRASH_BLOCK:
+                self.pool.release(b)
 
     def _finish(self, req, reason, events):
         with self._cond:
@@ -888,6 +1273,7 @@ class PagedLLMEngine(LLMEngine):
         with span("serving.step"):
             events = []
             self._sweep(events)
+            self._maybe_spill_idle()
             self._admit(events)
             self._prefill_chunks(events)
             self._decode_step(events)
@@ -899,6 +1285,9 @@ class PagedLLMEngine(LLMEngine):
         counters.set_gauge("serving.kv.blocks_used", used)
         self._observe("serving.kv.block_occupancy",
                       used / max(1, self.pool.capacity))
+        if self._host_tier is not None:
+            counters.set_gauge("serving.kv.tier.host_blocks",
+                               self._host_tier.resident)
         return events
 
     def stats(self):
@@ -928,5 +1317,13 @@ class PagedLLMEngine(LLMEngine):
                 "prefix_nodes": (0 if self.prefix is None
                                  else self.prefix.nodes),
                 "prefilling": len(self._prefill_state),
+                "host_tier_capacity": (0 if self._host_tier is None
+                                       else self._host_tier.capacity),
+                "host_tier_blocks": (0 if self._host_tier is None
+                                     else self._host_tier.resident),
+                "host_arena_bytes": (0 if self._host_tier is None
+                                     else self._host_tier.arena_bytes),
+                "tier_spilled": self.kv_tier_spilled,
+                "tier_restored": self.kv_tier_restored,
             })
         return st

@@ -67,11 +67,19 @@ class Router:
     ``degraded_factor`` further scales that margin while the health
     plane's admission level is ``degraded`` — the router tightens its own
     shed threshold on its own signal (see :meth:`pick`).
+    ``restore_cost`` prices host-tier prefix hits for the fleet-global
+    prefix economy: a device-resident cached token discounts a
+    candidate's backlog by 1.0, a host-resident one by ``1.0 -
+    restore_cost`` (it still beats re-prefilling elsewhere, but a page-in
+    is not free).  0.0 treats the tiers as equal, 1.0 ignores the host
+    tier entirely.
     """
 
-    def __init__(self, slo_margin=1.0, degraded_factor=2.0):
+    def __init__(self, slo_margin=1.0, degraded_factor=2.0,
+                 restore_cost=0.5):
         self.slo_margin = float(slo_margin)
         self.degraded_factor = float(degraded_factor)
+        self.restore_cost = min(1.0, max(0.0, float(restore_cost)))
         # the owning ServingFleet installs its HealthMonitor here; the
         # routing policy ACTS on its admission level (degraded tightens
         # the SLO shed margin, critical refuses new admissions) and
@@ -163,13 +171,16 @@ class Router:
         the full list is the last resort — a disaggregated fleet
         degrades to unified routing rather than refusing.
 
-        With ``prompt`` (the request's token ids) the score becomes
-        prefix-hit-aware: each candidate's backlog is discounted by the
-        prompt tokens its paged prefix cache could serve without
-        prefilling (``LLMEngine.prefix_peek``; 0 under the slot layout),
-        so shared-prompt traffic gravitates to the replica that already
-        holds the prefix instead of re-prefilling it elsewhere.  A pick
-        won on a nonzero discount counts ``serving.fleet.prefix_routed``.
+        With ``prompt`` (the request's token ids) the score becomes a
+        prefix-economy cost model: each candidate's backlog is discounted
+        by the prompt tokens its paged radix tree could serve
+        (``LLMEngine.prefix_probe``; ``(0, 0)`` under the slot layout) —
+        device-resident tokens at full weight, host-tier-resident tokens
+        discounted by ``restore_cost`` (they save the prefill FLOPs but
+        pay a page-in) — so shared-prompt traffic gravitates to the
+        replica already holding the longest prefix on EITHER tier instead
+        of re-prefilling it elsewhere.  A pick won on a nonzero discount
+        counts ``serving.fleet.prefix_routed``.
         """
         level = self._admission_level()
         if level == "critical" and shed:
@@ -197,8 +208,14 @@ class Router:
                              / st["decode_tps_ema"])
             if st["queued"] >= rep.engine.queue_size:
                 continue                # bounded queue full: not a candidate
-            peek = (rep.engine.prefix_peek(prompt)
-                    if prompt is not None else 0)
+            peek = 0.0
+            if prompt is not None:
+                probe = getattr(rep.engine, "prefix_probe", None)
+                if probe is not None:
+                    dev, host = probe(prompt)
+                    peek = dev + (1.0 - self.restore_cost) * host
+                else:
+                    peek = rep.engine.prefix_peek(prompt)
             cands.append((st["outstanding_tokens"] - peek, rep.idx,
                           rep, st, peek))
         if not cands:

@@ -50,11 +50,16 @@ import re
 import sys
 
 _HIGHER = ("tokens_per_sec", "mfu", "capacity_ratio", "goodput",
-           "hit_rate", "acceptance", "vs_baseline")
+           "hit_rate", "acceptance", "retention", "vs_baseline")
 _LOWER_RE = re.compile(
     r"(ttft|itl|queue_wait|latency|step_time|save|restore)"
     r"|(_ms$)|(^|\.)(p50|p95|p99|mean)(_ms)?$")
-_SKIP_RE = re.compile(r"(^|\.)(count|spread_frac|n_params)($|\.)")
+# traffic volumes, not performance: tier spill/restore block counts vary
+# with scheduling order (and "restored_blocks" would otherwise trip the
+# latency-ish "restore" token above)
+_SKIP_RE = re.compile(
+    r"(^|\.)(count|spread_frac|n_params|spilled_blocks|restored_blocks"
+    r"|host_buf_reuse|readopted|sheds)($|\.)")
 
 
 def classify(metric):

@@ -91,6 +91,18 @@ radix tree — a shared prefix is never moved twice), and the measured
 hand-offs must retrace nothing once the warm pass has compiled the
 migration gather.
 
+An eleventh phase gates the host-RAM KV tier
+(``LLMEngine(host_kv_blocks=...)``): a paged engine whose block pool is
+far smaller than its working set must stay token-identical — greedy AND
+seeded sampling — to the ample-pool engine while cold prefix chains
+spill to pinned host buffers and page back on demand; the measured
+spill/restore churn must retrace/trace/sync NOTHING and must not grow
+the host arena (every buffer comes from the reuse pool:
+``serving.kv.host_buf_reuse`` moves, ``serving.kv.host_arena_bytes``
+does not); and a ``kv_spill_drop`` fault mid-restore must degrade to a
+deterministic cache-miss replay with identical tokens and a reconciled
+block pool.
+
 Prints one JSON line; raises AssertionError on any violation.  Wired as a
 tier-1 test via tests/test_profiler.py.  Run directly:
 ``python scripts/check_counters.py``.
@@ -687,6 +699,90 @@ def run():
     for h, ref in zip(dhs, drefs):
         if list(h.tokens) != ref or h.finish_reason != "length":
             violations[f"disagg:identity@{h.rid}"] = (list(h.tokens), ref)
+
+    # ---- tiering gate: host-RAM KV tier economics -----------------------
+    # An oversubscribed paged engine (pool far smaller than the working
+    # set) backed by a host tier must (a) stay token-identical — greedy
+    # AND seeded sampling — to the ample-pool engine on the same
+    # prompts, (b) reach an allocation-free steady state: measured
+    # spill/restore churn with ZERO retraces/traces/syncs and a FLAT
+    # host arena (every buffer served by the reuse pool), and (c)
+    # degrade a dropped host copy (kv_spill_drop) to a deterministic
+    # cache-miss replay with both tiers reconciled.
+    TIER_PROMPTS = [rng.randint(0, 64, size=9).tolist() for _ in range(6)]
+
+    def tier_run(eng_, sampled=False):
+        outs = []
+        for i, p in enumerate(TIER_PROMPTS):   # sequential: each finished
+            h = eng_.add_request(p, max_new_tokens=4, seed=21 + i,
+                                 **(pq_sample if sampled else {}))
+            while not h.is_finished:           # seq donates, then the next
+                eng_.step()                    # admission forces spills
+            outs.append(list(h.tokens))
+        return outs
+
+    tbase = pq_engine(n_blocks=64)             # ample pool: never spills
+    tier_greedy = tier_run(tbase)
+    tier_sampled = tier_run(tbase, sampled=True)
+
+    teng = pq_engine(n_blocks=8, host_kv_blocks=64)   # 7 usable blocks
+    tier_run(teng)                  # warm: compiles spill/restore programs
+    tier_run(teng, sampled=True)    # ...and fills the buffer reuse pool
+    tbefore = counters.snapshot()
+    t_greedy = tier_run(teng)
+    t_sampled = tier_run(teng, sampled=True)
+    tsteady = counters.delta(tbefore)
+    if t_greedy != tier_greedy:
+        violations["tiering:greedy_identity"] = (t_greedy, tier_greedy)
+    if t_sampled != tier_sampled:
+        violations["tiering:sampled_identity"] = (t_sampled, tier_sampled)
+    for k in ("serving.retraces", "jit.traces", "jit.hydrates",
+              "jit.syncs"):
+        if tsteady.get(k, 0):
+            violations[f"tiering:{k}"] = (tsteady.get(k, 0), 0)
+    for k in ("serving.kv.tier.spilled_blocks",
+              "serving.kv.tier.restored_blocks",
+              "serving.kv.host_buf_reuse"):
+        if tsteady.get(k, 0) <= 0:
+            violations[f"tiering:{k}"] = (tsteady.get(k, 0), ">0")
+    # the no-malloc gate: a warm tier serves every spill/restore buffer
+    # from the reuse pool — the pinned arena never grows
+    if tsteady.get("serving.kv.host_arena_bytes", 0):
+        violations["tiering:host_arena_growth"] = (
+            tsteady.get("serving.kv.host_arena_bytes", 0), 0)
+
+    # chaos leg: re-establish the victim chain (the churn may have
+    # evicted it outright), force it host-resident, then drop its host
+    # copy mid-restore — admission degrades to a plain prefix miss and
+    # the replayed prefill is token-identical
+    th0 = teng.add_request(TIER_PROMPTS[0], max_new_tokens=4, seed=21)
+    while not th0.is_finished:
+        teng.step()
+    with teng._cond:
+        teng._spill_cold(32)
+    if teng.prefix_probe(np.asarray(TIER_PROMPTS[0], np.int32))[1] <= 0:
+        violations["tiering-chaos:victim_not_host"] = (
+            teng.prefix_probe(np.asarray(TIER_PROMPTS[0], np.int32)), ">0")
+    tdbefore = counters.snapshot()
+    th = teng.add_request(TIER_PROMPTS[0], max_new_tokens=4, seed=21)
+    with faultinject.fault_schedule(f"kv_spill_drop@{th.rid}"):
+        while not th.is_finished:
+            teng.step()
+    tdrop = counters.delta(tdbefore)
+    if list(th.tokens) != tier_greedy[0]:
+        violations["tiering-chaos:identity"] = (list(th.tokens),
+                                                tier_greedy[0])
+    if tdrop.get("resilience.faults_injected.kv_spill_drop", 0) != 1:
+        violations["tiering-chaos:faults"] = (
+            tdrop.get("resilience.faults_injected.kv_spill_drop", 0), 1)
+    if tdrop.get("serving.kv.tier.spill_drops", 0) <= 0:
+        violations["tiering-chaos:spill_drops"] = (
+            tdrop.get("serving.kv.tier.spill_drops", 0), ">0")
+    t_live = sum(1 for b in range(1, len(teng.pool._ref))
+                 if teng.pool._ref[b] > 0)
+    if len(teng.pool._free) + t_live != teng.pool.capacity:
+        violations["tiering-chaos:pool_leak"] = (
+            len(teng.pool._free) + t_live, teng.pool.capacity)
 
     # ---- resilience gate 1: saves cost ONE sync each, nothing else ------
     import tempfile
@@ -1287,6 +1383,15 @@ def run():
               "disagg_delta": {k: v for k, v in dsteady.items()
                                if k.startswith(("serving.fleet.migrate.",
                                                 "serving.retraces"))},
+              "tiering_delta": {k: v for k, v in tsteady.items()
+                                if k.startswith(("serving.kv.tier.",
+                                                 "serving.kv.host_",
+                                                 "serving.retraces",
+                                                 "jit.traces"))},
+              "tiering_chaos": {k: v for k, v in tdrop.items()
+                                if k.startswith(
+                                    ("serving.kv.tier.",
+                                     "resilience.faults_injected"))},
               "ckpt_steady_delta": {k: v for k, v in csteady.items()
                                     if k.startswith(("jit.", "resilience."))},
               "fault_delta": {k: v for k, v in rsteady.items()

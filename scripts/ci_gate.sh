@@ -4,7 +4,11 @@
 # BENCH_r0*.json history, then the steady-state counter invariants —
 # including the disagg phase (block-granular migration economics: copied
 # == owned non-shared blocks, prefix blocks never moved twice, zero
-# retraces across the prefill/decode split, token identity vs unified).
+# retraces across the prefill/decode split, token identity vs unified)
+# and the tiering phase (host-RAM KV tier under an oversubscribed pool:
+# spill/restore token identity for greedy AND seeded sampling, zero
+# steady-state retraces/syncs, flat host arena once the buffer reuse
+# pool is warm, and kv_spill_drop chaos degrading to a cache miss).
 #
 # Usage: scripts/ci_gate.sh        (from anywhere; cd's to the repo root)
 set -euo pipefail
@@ -26,7 +30,7 @@ elif [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
-echo "== ci_gate: steady-state counter invariants (incl. disagg) =="
+echo "== ci_gate: steady-state counter invariants (incl. disagg, tiering) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
     python scripts/check_counters.py
 

@@ -33,8 +33,9 @@ import paddle_tpu as paddle
 from paddle_tpu.core import flags as core_flags
 from paddle_tpu.profiler import counters
 from paddle_tpu.resilience import faultinject
-from paddle_tpu.serving import RetryAfter, Router, ServingFleet
-from paddle_tpu.serving.kvcache import blocks_for_tokens
+from paddle_tpu.serving import LLMEngine, RetryAfter, Router, ServingFleet
+from paddle_tpu.serving.kvcache import (TRASH_BLOCK, BlockPoolExhausted,
+                                        HostTierLost, blocks_for_tokens)
 
 
 @pytest.fixture(scope="module")
@@ -515,6 +516,28 @@ class TestAutoscaler:
         fleet.drain()
         assert counters.delta(before).get("serving.fleet.lost", 0) == 0
 
+    def test_kv_spill_burn_disaggregates_then_grows_decode(self, model):
+        """Sustained spill-rate burn is a capacity signal: a unified
+        fleet disaggregates (the split frees decode-side arena), an
+        already-split fleet flips surplus prefill capacity to decode."""
+        before = counters.snapshot()
+        uni = _fleet(model, autoscale=True)
+        uni.health.firing_names = lambda: {"kv_spill_burn"}
+        assert uni.autoscaler._evaluate() == "disaggregate"
+        assert uni.stats()["roles"]["prefill"] == 1
+        uni.drain()
+        dis = _fleet(model, replicas=3, prefill_replicas=2,
+                     autoscale=True)
+        dis.health.firing_names = lambda: {"kv_spill_burn"}
+        assert dis.autoscaler._evaluate() == "grow_decode"
+        assert dis.stats()["roles"] == \
+            {"prefill": 1, "decode": 2, "unified": 0}
+        dis.drain()
+        d = counters.delta(before)
+        assert d.get("serving.autoscale.decisions.disaggregate", 0) == 1
+        assert d.get("serving.autoscale.decisions.grow_decode", 0) == 1
+        assert d.get("serving.autoscale.flips.to_decode", 0) >= 2
+
     def test_inert_when_health_off(self, model):
         """FLAGS_health off: maybe_scale is a no-op and no autoscale
         counter moves (the zero-overhead-off gate)."""
@@ -527,3 +550,209 @@ class TestAutoscaler:
         d = counters.delta(before)
         assert d.get("serving.autoscale.decisions", 0) == 0
         assert d.get("serving.autoscale.flips.to_prefill", 0) == 0
+
+
+# -- host-RAM KV tier on the migration path ----------------------------------
+def _engine(m, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("min_bucket", 4)
+    kw.setdefault("block_size", BS)
+    kw.setdefault("prefill_chunk", 16)
+    return LLMEngine(m, kv_layout="paged", **kw)
+
+
+def _engine_reconciles(eng):
+    pool = eng.pool
+    live = sum(1 for b in range(1, len(pool._ref)) if pool._ref[b] > 0)
+    return len(pool._free) + live == pool.capacity
+
+
+def _ref_tokens(m, prompt, seed, max_new):
+    eng = _engine(m)
+    h = eng.add_request(prompt, max_new_tokens=max_new, seed=seed)
+    while not h.is_finished:
+        eng.step()
+    return h.tokens
+
+
+class TestHeldRequestSpill:
+    """A request parked ``"held"`` past ``spill_idle_steps`` demotes its
+    KV to the host tier (freeing device blocks for live traffic); the
+    export that finally migrates it pages everything back first — or
+    raises ``HostTierLost`` when the host copy is gone, with both tiers
+    reconciled and nothing torn."""
+
+    def test_idle_spill_then_export_restores_and_migrates(self, model):
+        rng = np.random.default_rng(30)
+        prompt = rng.integers(1, 64, size=27).tolist()  # 3 full + partial
+        ref = _ref_tokens(model, prompt, seed=5, max_new=6)
+        before = counters.snapshot()
+        src = _engine(model, host_kv_blocks=16, spill_idle_steps=2)
+        dst = _engine(model)
+        req = src.add_request(prompt, max_new_tokens=6, seed=5,
+                              hold_after_prefill=True)
+        for _ in range(8):
+            src.step()
+        assert req.state == "held"
+        d = counters.delta(before)
+        n_data = blocks_for_tokens(len(prompt), BS)
+        assert d.get("serving.kv.tier.spilled_blocks", 0) == n_data
+        table = src._slot_blocks[req.slot]
+        assert all(b == TRASH_BLOCK for b in table[:n_data])
+        assert src._host_tier.resident == n_data
+        mig = src.export_request(req)        # pages the KV back in
+        assert all(b != TRASH_BLOCK for b in mig["table"][:n_data])
+        assert src._host_tier.resident == 0
+        d = counters.delta(before)
+        assert d.get("serving.kv.tier.restored_blocks", 0) == n_data
+        assert d.get("serving.kv.host_buf_reuse", 0) >= 0
+        new_req, info = dst.adopt_migration(mig, src)
+        src.finish_migrated(req)
+        while not new_req.is_finished:
+            dst.step()
+        assert new_req.tokens == ref
+        assert info["blocks_copied"] == n_data
+        assert _engine_reconciles(src) and _engine_reconciles(dst)
+
+    def test_kv_spill_drop_on_export_raises_hosttierlost(self, model):
+        """Chaos: the spilled copy is dropped before the export can
+        restore it.  ``HostTierLost`` surfaces (the fleet's replay
+        signal), the tier empties, no device block was allocated for
+        the lost data, and the pool reconciles after teardown."""
+        rng = np.random.default_rng(31)
+        prompt = rng.integers(1, 64, size=27).tolist()
+        src = _engine(model, host_kv_blocks=16, spill_idle_steps=2)
+        req = src.add_request(prompt, max_new_tokens=6, seed=5,
+                              hold_after_prefill=True)
+        for _ in range(8):
+            src.step()
+        assert src._host_tier.resident > 0
+        before = counters.snapshot()
+        free_before = src.pool.free_blocks
+        with faultinject.fault_schedule(f"kv_spill_drop@{req.rid}"):
+            with pytest.raises(HostTierLost):
+                src.export_request(req)
+            assert ("kv_spill_drop", req.rid) in faultinject.fired
+        assert src._host_tier.resident == 0
+        assert src.pool.free_blocks == free_before
+        d = counters.delta(before)
+        assert d.get("serving.kv.tier.spill_drops", 0) == \
+            blocks_for_tokens(len(prompt), BS)
+        assert d.get("serving.kv.tier.restored_blocks", 0) == 0
+        src._finish(req, "dropped", [])
+        src.prefix.clear()
+        assert src.pool.free_blocks == src.pool.capacity
+
+    def test_adopt_reenters_prefix_into_destination_tree(self, model):
+        """Tentpole contract: a migrated prefix is shareable on the
+        destination IMMEDIATELY after adopt — the next same-prefix
+        prompt (or migration) resolves it from the radix tree without
+        waiting for the request to finish and donate."""
+        rng = np.random.default_rng(32)
+        prompt = rng.integers(1, 64, size=27).tolist()
+        src = _engine(model)
+        dst = _engine(model)
+        req = src.add_request(prompt, max_new_tokens=6, seed=5,
+                              hold_after_prefill=True)
+        while req.state != "held":
+            src.step()
+        new_req, _ = dst.adopt_migration(src.export_request(req), src)
+        src.finish_migrated(req)
+        n_full_tokens = (len(prompt) // BS) * BS
+        # still mid-decode on dst, yet the full prompt blocks are shared
+        assert new_req.state == "running"
+        assert dst.prefix_peek(np.asarray(prompt, np.int32)) == \
+            n_full_tokens
+        while not new_req.is_finished:
+            dst.step()
+        assert new_req.tokens == _ref_tokens(model, prompt, 5, 6)
+        assert _engine_reconciles(dst)
+
+    def test_destination_exhausted_mid_adopt_tears_nothing(self, model):
+        """Satellite: adopt against a pool that cannot host the table
+        raises ``BlockPoolExhausted`` with NOTHING allocated on the
+        destination and the source intact — the same payload then
+        adopts cleanly elsewhere."""
+        rng = np.random.default_rng(33)
+        prompt = rng.integers(1, 64, size=27).tolist()
+        src = _engine(model)
+        tiny = _engine(model, n_blocks=3, prefix_cache=False)
+        req = src.add_request(prompt, max_new_tokens=6, seed=5,
+                              hold_after_prefill=True)
+        while req.state != "held":
+            src.step()
+        mig = src.export_request(req)
+        before = counters.snapshot()
+        free_before = tiny.pool.free_blocks
+        with pytest.raises(BlockPoolExhausted):
+            tiny.adopt_migration(mig, src)
+        assert tiny.pool.free_blocks == free_before
+        assert all(r is None for r in tiny._slots)
+        assert counters.delta(before).get(
+            "serving.kv.pool_exhausted", 0) == 1
+        # the source never moved: the same export adopts cleanly
+        dst = _engine(model)
+        new_req, _ = dst.adopt_migration(mig, src)
+        src.finish_migrated(req)
+        while not new_req.is_finished:
+            dst.step()
+        assert new_req.tokens == _ref_tokens(model, prompt, 5, 6)
+
+    def test_int8_partial_block_scale_rows_survive_tier_roundtrip(
+            self, model):
+        """Satellite: an int8 arena spills fp32 scale rows alongside
+        the quantised tiles.  A held request whose last block is
+        partial round-trips through the host tier, migrates, and the
+        destination's scale rows match the source bit for bit."""
+        rng = np.random.default_rng(34)
+        prompt = rng.integers(1, 64, size=27).tolist()  # partial of 3
+        ref_eng = _engine(model, kv_dtype="int8")
+        hr = ref_eng.add_request(prompt, max_new_tokens=6, seed=5)
+        while not hr.is_finished:
+            ref_eng.step()
+        src = _engine(model, kv_dtype="int8", host_kv_blocks=16,
+                      spill_idle_steps=2)
+        dst = _engine(model, kv_dtype="int8")
+        req = src.add_request(prompt, max_new_tokens=6, seed=5,
+                              hold_after_prefill=True)
+        for _ in range(8):
+            src.step()
+        n_data = blocks_for_tokens(len(prompt), BS)
+        assert src._host_tier.resident == n_data       # scales spilled too
+        mig = src.export_request(req)
+        new_req, _ = dst.adopt_migration(mig, src)
+        sk_src = np.asarray(src._sk)
+        sk_dst = np.asarray(dst._sk)
+        dtable = dst._slot_blocks[new_req.slot]
+        pos = int(mig["pos"])
+        for i in range(n_data):
+            valid = min(BS, pos - i * BS)              # partial last block
+            assert np.array_equal(sk_src[:, mig["table"][i], :valid],
+                                  sk_dst[:, dtable[i], :valid]), \
+                f"scale rows of block {i} diverged"
+        src.finish_migrated(req)
+        while not new_req.is_finished:
+            dst.step()
+        assert new_req.tokens == hr.tokens
+        assert _engine_reconciles(src) and _engine_reconciles(dst)
+
+    def test_fleet_rolls_up_tier_stats(self, model):
+        """Fleet stats aggregate the per-engine tier view; a tiered
+        disaggregated stream completes with zero lost requests."""
+        rng = np.random.default_rng(35)
+        prompts = _prompts(rng, (24, 9, 40, 17))
+        before = counters.snapshot()
+        fleet = _fleet(model, prefill_replicas=1, host_kv_blocks=16)
+        hs = [fleet.submit(p, seed=i, max_new_tokens=6)
+              for i, p in enumerate(prompts)]
+        fleet.join(hs)
+        st = fleet.stats()["kv"]
+        fleet.drain()
+        assert st["host_tier_capacity"] == 16 * 2      # both replicas
+        assert st["host_tier_blocks"] >= 0
+        assert {"host_arena_bytes", "tier_spilled",
+                "tier_restored"} <= set(st)
+        d = counters.delta(before)
+        assert d.get("serving.fleet.lost", 0) == 0
+        assert all(h.finish_reason == "length" for h in hs)

@@ -16,8 +16,8 @@ import paddle_tpu as paddle
 from paddle_tpu.profiler import counters
 from paddle_tpu.resilience import faultinject
 from paddle_tpu.serving.kvcache import (TRASH_BLOCK, BlockPool,
-                                        BlockPoolExhausted, PrefixCache,
-                                        blocks_for_tokens)
+                                        BlockPoolExhausted, HostKVTier,
+                                        PrefixCache, blocks_for_tokens)
 
 _MODEL = None
 
@@ -478,3 +478,232 @@ class TestFleetPagedChaos:
                 assert r.finish_reason in ("length", "eos")
                 ref = _ref_generate(m, list(r.prompt), 4)
                 assert r.tokens == ref
+
+
+def _pool_reconciles(eng):
+    pool = eng.pool
+    live = sum(1 for b in range(1, len(pool._ref)) if pool._ref[b] > 0)
+    return len(pool._free) + live == pool.capacity
+
+
+class TestHostKVTierUnit:
+    SPEC = (((2, 4, 2, 8), np.dtype(np.float32)),
+            ((2, 4, 2, 8), np.dtype(np.float32)))
+
+    def test_acquire_reuse_and_arena_gauge(self):
+        before = counters.snapshot()
+        tier = HostKVTier(4)
+        bufs = tier.acquire(self.SPEC)
+        assert len(bufs) == 2 and all(b.shape == (2, 4, 2, 8)
+                                      for b in bufs)
+        nbytes = sum(b.nbytes for b in bufs)
+        assert tier.arena_bytes == nbytes
+        # recycle via pop, then re-acquire: pool hit, no new bytes
+        tier.put("a", bufs)
+        assert tier.pop("a") is True
+        again = tier.acquire(self.SPEC)
+        assert tier.arena_bytes == nbytes                # flat once warm
+        d = counters.delta(before)
+        assert d.get("serving.kv.host_buf_reuse", 0) == 2
+        # last-write-wins gauge: this tier published its arena total
+        # (delta vs `before` would see other engines' tiers)
+        assert counters.get("serving.kv.host_arena_bytes") == nbytes
+        assert {id(b) for b in again} == {id(b) for b in bufs}
+
+    def test_put_lru_overflow_returns_dropped_keys(self):
+        tier = HostKVTier(2)
+        for key in ("a", "b"):
+            assert tier.put(key, tier.acquire(self.SPEC)) == []
+        # touching "a" makes "b" the LRU victim of the next overflow
+        assert tier.get("a") is not None
+        dropped = tier.put("c", tier.acquire(self.SPEC))
+        assert dropped == ["b"]
+        assert tier.resident == 2
+        assert tier.get("b") is None
+        # the dropped entry's buffers were recycled, not leaked
+        tier.put("d", tier.acquire(self.SPEC))
+        bytes_before = tier.arena_bytes
+        assert tier.arena_bytes == bytes_before
+
+    def test_pop_is_tolerant_of_absent_keys(self):
+        tier = HostKVTier(1)
+        assert tier.pop("nope") is False
+        with pytest.raises(ValueError):
+            HostKVTier(0)
+
+
+def _tiered(m, **kw):
+    kw.setdefault("n_blocks", 10)
+    kw.setdefault("host_kv_blocks", 32)
+    kw.setdefault("max_slots", 2)
+    return _paged(m, **kw)
+
+
+class TestKVTiering:
+    """Tentpole: cold KV spills to pinned host RAM and pages back on
+    demand — token identity is preserved across the round-trip, the
+    host reuse pool keeps steady-state traffic allocation-free, and a
+    dropped host copy degrades to a deterministic cache-miss replay."""
+
+    def test_oversubscribed_identity_greedy(self):
+        m = _model()
+        rng = np.random.default_rng(20)
+        prompts = [rng.integers(0, 64, size=9).tolist() for _ in range(6)]
+        refs = [_ref_generate(m, p, 4) for p in prompts]
+        before = counters.snapshot()
+        eng = _tiered(m)                 # 9 usable blocks, far too few
+        for two_pass in range(2):        # pass 2 restores what 1 spilled
+            for i, p in enumerate(prompts):
+                h = eng.add_request(p, max_new_tokens=4, seed=i)
+                _run(eng, [h])
+                assert h.tokens == refs[i], \
+                    f"pass {two_pass} prompt {i} diverged"
+        d = counters.delta(before)
+        assert d.get("serving.kv.tier.spilled_blocks", 0) > 0
+        assert d.get("serving.kv.tier.restored_blocks", 0) > 0
+        assert d.get("serving.kv.host_buf_reuse", 0) > 0
+        assert _pool_reconciles(eng)
+        eng.prefix.clear()
+        assert eng.pool.free_blocks == eng.pool.capacity
+        assert eng._host_tier.resident == 0
+
+    def test_oversubscribed_identity_sampled(self):
+        m = _model()
+        rng = np.random.default_rng(21)
+        prompts = [rng.integers(0, 64, size=9).tolist() for _ in range(5)]
+        kw = dict(do_sample=True, temperature=0.8, top_k=8, top_p=0.9)
+        ample = _paged(m, n_blocks=64, max_slots=2)
+        refs = []
+        for i, p in enumerate(prompts):
+            h = ample.add_request(p, max_new_tokens=4, seed=50 + i, **kw)
+            _run(ample, [h])
+            refs.append(h.tokens)
+        before = counters.snapshot()
+        eng = _tiered(m)
+        for _ in range(2):
+            for i, p in enumerate(prompts):
+                h = eng.add_request(p, max_new_tokens=4, seed=50 + i,
+                                    **kw)
+                _run(eng, [h])
+                assert h.tokens == refs[i]
+        d = counters.delta(before)
+        assert d.get("serving.kv.tier.spilled_blocks", 0) > 0
+        assert d.get("serving.kv.tier.restored_blocks", 0) > 0
+        assert _pool_reconciles(eng)
+
+    def test_steady_state_spill_restore_compiles_nothing(self):
+        """After one warm cycle compiled the one-block gather/scatter
+        programs, further spill/restore churn traces nothing and the
+        host arena stays flat (the reuse pool covers every buffer)."""
+        m = _model()
+        rng = np.random.default_rng(22)
+        prompts = [rng.integers(0, 64, size=9).tolist() for _ in range(6)]
+        eng = _tiered(m)
+        for p in prompts:                          # warm: compiles + fills
+            _run(eng, [eng.add_request(p, max_new_tokens=4, seed=3)])
+        before = counters.snapshot()
+        for p in prompts:                          # measured churn
+            _run(eng, [eng.add_request(p, max_new_tokens=4, seed=3)])
+        d = counters.delta(before)
+        assert d.get("serving.kv.tier.spilled_blocks", 0) > 0
+        assert d.get("serving.kv.tier.restored_blocks", 0) > 0
+        assert d.get("serving.retraces", 0) == 0
+        assert d.get("serving.kv.host_arena_bytes", 0) == 0
+        assert d.get("serving.kv.host_buf_reuse", 0) > 0
+
+    def test_kv_spill_drop_degrades_to_cache_miss(self):
+        """Chaos: the host copy vanishes mid-restore — the chain is
+        dropped, admission proceeds as a plain prefix miss, and the
+        replayed prefill is token-identical."""
+        m = _model()
+        rng = np.random.default_rng(23)
+        p = rng.integers(0, 64, size=9).tolist()   # 9 + 4 - 1 = 3 blocks
+        eng = _tiered(m)
+        h1 = eng.add_request(p, max_new_tokens=4, seed=0)
+        _run(eng, [h1])
+        with eng._cond:
+            assert eng._spill_cold(3) == 3         # whole chain to host
+        assert eng._host_tier.resident == 3
+        before = counters.snapshot()
+        h2 = eng.add_request(p, max_new_tokens=4, seed=0)
+        with faultinject.fault_schedule(f"kv_spill_drop@{h2.rid}"):
+            _run(eng, [h2])
+            assert ("kv_spill_drop", h2.rid) in faultinject.fired
+        assert h2.tokens == h1.tokens == _ref_generate(m, p, 4)
+        d = counters.delta(before)
+        assert d.get("serving.kv.tier.spill_drops", 0) == 3
+        assert d.get("serving.kv.tier.restored_blocks", 0) == 0
+        assert d.get("resilience.faults_injected.kv_spill_drop", 0) == 1
+        assert d.get("serving.kv.prefix_misses", 0) >= 1
+        assert eng._host_tier.resident == 0
+        assert _pool_reconciles(eng)
+
+    def test_readoption_flips_host_node_back_for_free(self):
+        """A donor inserting over a host-resident node re-adopts it to
+        device residency without any host->device copy: the donor's
+        live block simply replaces the spilled one."""
+        m = _model()
+        rng = np.random.default_rng(24)
+        p = rng.integers(0, 64, size=9).tolist()
+        eng = _tiered(m)
+        h1 = eng.add_request(p, max_new_tokens=4, seed=0)
+        _run(eng, [h1])
+        with eng._cond:
+            eng._spill_cold(3)
+        before = counters.snapshot()
+        # admission pages back only the first 2 blocks (the match limit
+        # is prompt-1 = 8 tokens); the third host node is re-adopted at
+        # donation time — the finishing request carries a live device
+        # copy of the same tokens, so residency flips back for free
+        h2 = eng.add_request(p, max_new_tokens=4, seed=0)
+        _run(eng, [h2])
+        d = counters.delta(before)
+        assert d.get("serving.kv.tier.restored_blocks", 0) == 2
+        assert d.get("serving.kv.tier.readopted", 0) == 1
+        assert h2.tokens == h1.tokens
+        assert eng._host_tier.resident == 0
+        assert _pool_reconciles(eng)
+
+
+class TestHostTierRouting:
+    def test_probe_reports_host_tokens_and_router_prices_restore(self):
+        m = _model()
+        from paddle_tpu.serving import Replica, Router
+        rng = np.random.default_rng(25)
+        sys_p = rng.integers(0, 64, size=8).tolist()
+        warm = _tiered(m)
+        cold = _paged(m)
+        h = warm.add_request(sys_p + [1, 2], max_new_tokens=3, seed=0)
+        _run(warm, [h])                  # KV = 12 tokens = 3 full blocks
+        with warm._cond:
+            assert warm._spill_cold(3) == 3
+        probe_p = np.asarray(sys_p + [9, 9], np.int32)
+        dev, host = warm.prefix_probe(probe_p)
+        assert dev == 0 and host == 8    # whole prefix is host-resident
+        assert cold.prefix_probe(probe_p) == (0, 0)
+        reps = [Replica(0, cold), Replica(1, warm)]
+        before = counters.snapshot()
+        picked = Router().pick(reps, est_tokens=16, prompt=probe_p)
+        assert picked.engine is warm     # host tokens still win routing
+        d = counters.delta(before)
+        assert d.get("serving.fleet.prefix_routed", 0) == 1
+        # restore_cost=1.0 prices paging at a full re-prefill: the
+        # host-resident prefix carries no edge and the tie breaks cold
+        router = Router(restore_cost=1.0)
+        assert router.pick(reps, est_tokens=16,
+                           prompt=probe_p).engine is cold
+
+    def test_digest_short_circuits_cold_probes(self):
+        pool = BlockPool(9, 4)
+        cache = PrefixCache(pool)
+        seq = list(range(8))
+        blocks = pool.alloc_n(2)
+        cache.insert(seq, blocks)
+        for b in blocks:
+            pool.release(b)
+        assert cache.digest() == frozenset({hash(tuple(seq[:4]))})
+        # digest miss: a full-block probe of unseen tokens never walks
+        assert cache.probe([40] * 8, limit=8) == (0, 0)
+        assert cache.probe(seq, limit=8) == (8, 0)
+        cache.clear()
+        assert cache.digest() == frozenset()
