@@ -77,7 +77,12 @@ the fleet leg additionally smoke-hits the live ops endpoint (OpsServer
 /healthz + /traces over HTTP, ephemeral port) while the fleet is up; the
 ckpt leg embeds save-latency percentiles; the mesh legs embed
 per-compiled-program HBM bytes ("hbm") captured via XLA memory analysis
-under FLAGS_device_telemetry.
+under FLAGS_device_telemetry.  The serve / paged / spec legs embed a
+"devicetime" block (per-program device-time share / mean / MFU from the
+FLAGS_device_time_sample ledger, captured in a short UNTIMED post-window
+pass so the sampling fences never touch a gated number) —
+``bench_compare.py --attribute`` diffs these shares to name the program
+behind any regression.
 Set PTPU_BENCH=125m|760m|serve|paged|paged_q|tiered|spec|ckpt|fleet|disagg|mesh|mesh760m
 to run a single leg.  PTPU_FUSED_STEPS sets the fused window length K (default 4; 1
 disables the fused leg).  PTPU_MESH picks the mesh leg's axis degrees.
@@ -110,6 +115,32 @@ def _goodput_summary(ledger):
             "wall_s": round(r["wall_s"], 4),
             "buckets_s": {k: round(v, 4)
                           for k, v in r["buckets_s"].items() if v}}
+
+
+def _sampled_devicetime(run_fn, sample=4, top=8):
+    """Per-program device-time/MFU attribution block for one leg.
+
+    Runs ``run_fn`` (a short UNTIMED window on the leg's already-warm
+    engine) with ``FLAGS_device_time_sample=N`` + device telemetry on, so
+    the ledger joins sampled fence times with AOT FLOPs/HBM stats, then
+    restores the flags and returns ``devicetime.bench_block``.  Always
+    runs AFTER the leg's gated timing windows: the sampled syncs (and the
+    one-off AOT captures) never perturb a gated number."""
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.profiler import devicetime
+    saved = {k: _flags.flag(k) for k in ("FLAGS_device_time_sample",
+                                         "FLAGS_device_telemetry")}
+    devicetime.reset()
+    _flags.set_flags({"FLAGS_device_time_sample": int(sample),
+                      "FLAGS_device_telemetry": True})
+    try:
+        run_fn()
+        block = devicetime.bench_block(top=top)   # flags still live: the
+        # block records the sample rate + joined MFU it measured with
+    finally:
+        _flags.set_flags(saved)
+    devicetime.reset()
+    return block
 
 
 def _run_leg(cfg, batch, seq, iters, rounds, fused_steps=1):
@@ -388,6 +419,9 @@ def _run_serve_leg(cfg, n_requests=64, max_new=64, max_slots=8,
         raise AssertionError(
             "serving leg: engine output diverged from sequential "
             "GPT.generate")
+    leg["devicetime"] = _sampled_devicetime(
+        lambda: [None for _ in eng.generate(prompts[:4],
+                                            max_new_tokens=8)])
     del eng, model
     return leg
 
@@ -573,6 +607,9 @@ def _run_paged_leg(cfg, n_requests=64, max_new=64, max_slots=8,
                    4)},
            "blocks_evicted": pstats["blocks_evicted"],
            "cow_copies": pstats["cow_copies"]}
+    leg["devicetime"] = _sampled_devicetime(
+        lambda: [None for _ in pc_eng.generate(prompts[:4],
+                                               max_new_tokens=8)])
     del peng, pc_eng, model
     return leg
 
@@ -863,6 +900,9 @@ def _run_spec_leg(n_requests=16, max_new=32, max_slots=4, min_bucket=8,
            "ttft_spec": _latency_ms(spec_snap["serving.ttft_ns"]),
            "itl_base": _latency_ms(base_snap["serving.itl_ns"]),
            "itl_spec": _latency_ms(spec_snap["serving.itl_ns"])}
+    leg["devicetime"] = _sampled_devicetime(
+        lambda: [None for _ in seng.generate(prompts[:4],
+                                             max_new_tokens=8)])
     del seng, target, draft
     return leg
 

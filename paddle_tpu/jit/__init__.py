@@ -28,6 +28,7 @@ from ..core import flags as _flags
 from ..core.state import STATE, no_grad_guard
 from ..core.tensor import Parameter, Tensor
 from ..profiler import counters as _counters
+from ..profiler import devicetime as _devicetime
 from ..profiler import flight as _flight
 from ..profiler import host_tracer as _trace
 from ..profiler import metrics as _metrics
@@ -944,6 +945,9 @@ class CompiledTrainStep:
                 donate_argnums=donate + ((7,) if donate and mon else ()),
                 expect_no_collectives=self.mesh is None)
         traces_before = _counters.get("jit.traces")
+        _dt = (_devicetime.note(
+            f"jit.step[check={int(check)},metrics={int(mon)}]")
+            if _devicetime.enabled() else None)
         with _trace.span("jit.dispatch"):
             _counters.inc("jit.host.dispatches")
             _flight.record("jit.dispatch",
@@ -959,6 +963,8 @@ class CompiledTrainStep:
                  new_rng, checks) = jit_fn(params, buffers, opt_state,
                                            self._lr_dev, rng_key, sstate,
                                            args_data)
+        if _dt is not None:
+            _devicetime.observe(_dt, (loss, new_params, new_opt))
         _counters.inc("jit.cache_hits"
                       if _counters.get("jit.traces") == traces_before
                       else "jit.cache_misses")
@@ -1009,6 +1015,9 @@ class CompiledTrainStep:
                 donate_argnums=donate + ((7,) if donate and mon else ()),
                 expect_no_collectives=self.mesh is None)
         traces_before = _counters.get("jit.traces")
+        _dt = (_devicetime.note(
+            f"jit.window[check={int(check)},k={k},metrics={int(mon)}]")
+            if _devicetime.enabled() else None)
         with _trace.span("jit.dispatch"):
             _counters.inc("jit.host.dispatches")
             _flight.record("jit.dispatch",
@@ -1024,6 +1033,8 @@ class CompiledTrainStep:
                  new_rng, checks) = jit_fn(params, buffers, opt_state,
                                            self._lrs_dev, rng_key, sstate,
                                            args_data)
+        if _dt is not None:
+            _devicetime.observe(_dt, (losses, new_params, new_opt))
         _counters.inc("jit.cache_hits"
                       if _counters.get("jit.traces") == traces_before
                       else "jit.cache_misses")

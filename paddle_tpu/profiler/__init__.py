@@ -17,6 +17,14 @@ Pieces:
   ``jit.CompiledTrainStep(metrics=...)``), Prometheus text exposition,
   and per-compiled-program HBM/compile/FLOPs telemetry
   (``memory_summary()``, gated by ``FLAGS_device_telemetry``).
+* ``devicetime`` — the device-time & efficiency plane: a per-program
+  ``ProgramLedger`` noted at every compiled-program dispatch site
+  (``FLAGS_device_time_sample=N`` fences every Nth dispatch; 0 = one
+  cached read, zero counters), joining sampled wall time with the AOT
+  FLOPs/HBM stats into live MFU / achieved-TFLOP/s / HBM-GB/s /
+  roofline gauges, a Paddle-style ``summary()`` table, bench-leg
+  attribution blocks, and a single-flight ``capture_profile`` XPlane
+  window (``POST /profile``).
 * ``flight`` — always-on flight-recorder ring buffer; faults (trainer
   recovery, nan/inf raise, fleet replica death/stall) dump a postmortem
   JSON bundle (``scripts/flight_dump.py`` pretty-prints it).
@@ -60,6 +68,7 @@ import time
 from enum import Enum
 
 from . import counters  # noqa: F401
+from . import devicetime  # noqa: F401
 from . import flight  # noqa: F401
 from . import goodput  # noqa: F401
 from . import host_tracer  # noqa: F401

@@ -17,6 +17,11 @@ Well-known names (see README "Observability" for the full table):
   jit.host.layer_state / jit.host.bind_layer_state /
   jit.host.optimizer_state / jit.host.bind_optimizer_state
   jit.nan_inf_checks / jit.nan_inf_hits (FLAGS_check_nan_inf sweeps)
+  jit.devicetime.dispatches (dispatches noted by the device-time ledger
+      while FLAGS_device_time_sample > 0; 0 when sampling is off)
+  jit.devicetime.sampled_syncs (explicit block-until-ready fences the
+      sampler paid — exactly ceil(dispatches / N) over a window started
+      by devicetime.reset(); the sync-budget gate's devicetime line)
   static.runs / static.compiles / static.traces
   io.device_put_calls / io.device_put_bytes
   io.stack_windows / io.stack_batches
@@ -117,7 +122,9 @@ Well-known names (see README "Observability" for the full table):
       harvested by metrics_flush at sync boundaries)
   flight.dumps / flight.dumps.<reason> (postmortem bundles written)
   program.<name>.<field> (gauges: per-compiled-program HBM bytes /
-      compile seconds / FLOPs under FLAGS_device_telemetry)
+      compile seconds / FLOPs under FLAGS_device_telemetry; the
+      device-time ledger adds device_time_mean_ms / device_time_samples
+      / tflops / mfu / hbm_gbps / ai under FLAGS_device_time_sample)
   serving.fleet.slow_decode_stalls (injected slow_decode stall beats)
   trace.started / trace.finished / trace.spans (request tracing; all 0
       when FLAGS_request_trace_sample=0 — the zero-overhead-off gate)

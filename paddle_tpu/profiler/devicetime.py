@@ -1,0 +1,435 @@
+"""Device-time & efficiency plane: the per-program device-time ledger.
+
+Reference analogue: Paddle's profiler kernel-level device timeline +
+``summary()`` tables (profiler/profiler_statistic.py) — here grown
+TPU-natively on top of the per-compiled-program AOT telemetry
+(:func:`metrics.capture_program_stats`) instead of CUPTI:
+
+* **ProgramLedger** (module-level, like the counter registry): every
+  dispatch site in the stack — jit single-step and fused window, slot /
+  paged / speculative prefill-decode-verify, COW block copy, migration
+  export/adopt, tier spill/restore — calls :func:`note` with its
+  program name.  With ``FLAGS_device_time_sample=0`` (the default) a
+  note is ONE cached list read and returns ``None``: zero counters
+  move, zero syncs happen, steady-state parity gates stay byte-
+  identical.
+* **Sampling**: with ``FLAGS_device_time_sample=N`` every Nth noted
+  dispatch (globally, across programs) returns a token; the site passes
+  the token plus the dispatch outputs to :func:`observe`, which pays
+  ONE explicit ``jax.block_until_ready`` fence, ticks
+  ``jit.devicetime.sampled_syncs`` (so the zero-sync gates can budget
+  it exactly: ⌈dispatches/N⌉), and records the fenced wall time into
+  the per-program ledger row + log2 histogram.
+* **Efficiency join**: each sample joins the program's AOT FLOPs and
+  HBM bytes (``arg_bytes + out_bytes`` — the off-chip traffic floor)
+  from :func:`metrics.program_stats` to publish live per-program
+  gauges: achieved TFLOP/s, MFU vs ``FLAGS_peak_tflops``, HBM GB/s vs
+  ``FLAGS_peak_hbm_gbps``, arithmetic intensity, and a roofline
+  classification (compute-bound when AI exceeds the machine balance
+  point, bandwidth-bound below it).
+* **Consumers**: :func:`summary` (Paddle-profiler-style table),
+  :func:`snapshot` (the ``/programs`` OpsServer endpoint +
+  ``ServingFleet.stats()["devicetime"]`` roll-up), :func:`bench_block`
+  (embedded in bench legs, diffed by ``bench_compare.py --attribute``),
+  the flight-recorder postmortem bundle, and the ``mfu_collapse`` /
+  ``device_time_regression`` health watchdogs.
+* **On-demand XPlane capture**: :func:`capture_profile` drives a
+  single-flight, timeout-clamped ``jax.profiler`` start/stop_trace
+  window (the ``POST /profile?ms=`` endpoint) and returns the dump
+  directory for offline tooling.
+
+Timing model: the fence measures host wall time from just before the
+dispatch call to device completion — on a steady async pipeline that is
+(queue drain + this program's device time); with one in-flight program
+(the serving engines' data-dependent loops) it is the program's device
+time plus constant host overhead.  Sampled means are therefore honest
+*attribution* weights (share of where time goes) rather than isolated
+kernel runtimes — exactly what regression triage needs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import tempfile
+import threading
+import time
+
+from ..core import flags as _flags
+from . import counters as _counters
+from . import metrics as _metrics
+
+# -- ledger state ------------------------------------------------------------
+_LOCK = threading.Lock()
+_LEDGER: dict[str, dict] = {}
+_SAMPLE = [0]          # observer-cached FLAGS_device_time_sample (hot read)
+_SEQ = itertools.count()   # global dispatch sequence: every Nth is sampled
+_RECENT = 8            # trailing per-program samples kept for regression ratio
+
+_COUNTER_DISPATCHES = "jit.devicetime.dispatches"
+_COUNTER_SAMPLED = "jit.devicetime.sampled_syncs"
+
+
+class _Token:
+    """One armed sample: carries the program name and the pre-dispatch
+    timestamp from :func:`note` to :func:`observe`."""
+
+    __slots__ = ("name", "t0")
+
+    def __init__(self, name, t0):
+        self.name = name
+        self.t0 = t0
+
+
+def enabled() -> bool:
+    """True when device-time sampling is on (``FLAGS_device_time_sample>0``)."""
+    return _SAMPLE[0] > 0
+
+
+def sample_every() -> int:
+    return _SAMPLE[0]
+
+
+def note(name):
+    """Note one dispatch of program ``name``.
+
+    OFF (``FLAGS_device_time_sample=0``): one list read, returns ``None``
+    — no counters, no locks, no allocation.  ON: counts the dispatch in
+    the ledger and, for every Nth note globally, returns a :class:`_Token`
+    the dispatch site must hand to :func:`observe` together with the
+    dispatch outputs.  Call it immediately before the dispatch (after any
+    AOT capture / audit work, so compile time never leaks into samples).
+    """
+    n = _SAMPLE[0]
+    if n <= 0:
+        return None
+    _counters.inc(_COUNTER_DISPATCHES)
+    with _LOCK:
+        rec = _LEDGER.get(name)
+        if rec is None:
+            rec = _LEDGER[name] = {
+                "dispatches": 0, "sampled": 0, "time_s": 0.0,
+                "recent": [],
+                "hist": _metrics.Histogram(f"devicetime.{name}", "ns"),
+            }
+        rec["dispatches"] += 1
+        armed = next(_SEQ) % n == 0
+    if not armed:
+        return None
+    return _Token(name, time.perf_counter())
+
+
+def observe(token, out=None):
+    """Complete a sample armed by :func:`note`: fence on ``out`` (any
+    pytree of device arrays; ``None`` fences nothing) and record the
+    elapsed wall time against the token's program.  No-op on ``None``
+    token, so sites can write ``_dt = note(..); ...; observe(_dt, out)``
+    unconditionally."""
+    if token is None:
+        return None
+    _block(out)
+    dt = time.perf_counter() - token.t0
+    _record_sample(token.name, dt)
+    return dt
+
+
+def _block(out):
+    """Explicit device fence (the one sync sampling pays)."""
+    if out is None:
+        return
+    try:
+        import jax
+        jax.block_until_ready(out)
+    except Exception:
+        # partial/host-only outputs: fence whatever leaves we can
+        try:
+            import jax
+            for leaf in jax.tree_util.tree_leaves(out):
+                try:
+                    leaf.block_until_ready()
+                except Exception:
+                    pass
+        except Exception:
+            pass
+
+
+def _record_sample(name, dt_s):
+    """Fold one fenced wall-time sample into the ledger and republish the
+    program's efficiency gauges.  (Also the test seam: feeds the ledger
+    without a real dispatch.)"""
+    _counters.inc(_COUNTER_SAMPLED)
+    with _LOCK:
+        rec = _LEDGER.get(name)
+        if rec is None:
+            rec = _LEDGER[name] = {
+                "dispatches": 0, "sampled": 0, "time_s": 0.0,
+                "recent": [],
+                "hist": _metrics.Histogram(f"devicetime.{name}", "ns"),
+            }
+        rec["sampled"] += 1
+        rec["time_s"] += dt_s
+        rec["recent"].append(dt_s)
+        if len(rec["recent"]) > _RECENT:
+            del rec["recent"][:len(rec["recent"]) - _RECENT]
+        rec["hist"].record(dt_s * 1e9)
+        mean_s = rec["time_s"] / rec["sampled"]
+    eff = _efficiency(name, mean_s)
+    fields = {"device_time_mean_ms": mean_s * 1e3,
+              "device_time_samples": float(_samples_of(name))}
+    for k in ("tflops", "mfu", "hbm_gbps", "ai"):
+        if eff.get(k) is not None:
+            fields[k] = eff[k]
+    _metrics.record_program(name, **fields)
+    if eff.get("roofline"):
+        with _metrics._PLOCK:
+            _metrics._PROGRAMS.setdefault(name, {"name": name})[
+                "roofline"] = eff["roofline"]
+
+
+def _samples_of(name):
+    with _LOCK:
+        rec = _LEDGER.get(name)
+        return rec["sampled"] if rec else 0
+
+
+# -- efficiency join ---------------------------------------------------------
+def _efficiency(name, mean_s):
+    """Join one program's mean device time with its AOT FLOPs/HBM bytes
+    (when ``capture_program_stats`` recorded them) into achieved TFLOP/s,
+    MFU, HBM GB/s, arithmetic intensity and a roofline classification.
+    Missing inputs degrade field-by-field, never raise."""
+    out = {"tflops": None, "mfu": None, "hbm_gbps": None, "ai": None,
+           "roofline": None}
+    if not mean_s or mean_s <= 0:
+        return out
+    stats = _metrics.program_stats(name)
+    flops = stats.get("flops")
+    hbm = 0
+    for k in ("arg_bytes", "out_bytes"):
+        v = stats.get(k)
+        if isinstance(v, (int, float)):
+            hbm += v
+    if isinstance(flops, (int, float)) and flops > 0:
+        out["tflops"] = flops / mean_s / 1e12
+        peak_tf = float(_flags.flag("FLAGS_peak_tflops") or 0.0)
+        if peak_tf > 0:
+            out["mfu"] = out["tflops"] / peak_tf
+    if hbm > 0:
+        out["hbm_gbps"] = hbm / mean_s / 1e9
+        if isinstance(flops, (int, float)) and flops > 0:
+            out["ai"] = flops / hbm
+    out["roofline"] = _roofline(
+        flops if isinstance(flops, (int, float)) else None,
+        hbm if hbm > 0 else None)
+    return out
+
+
+def _roofline(flops, hbm_bytes):
+    """'compute-bound' / 'bandwidth-bound' / 'unknown' from AOT stats and
+    the peak flags.  A zero-FLOP program that moves bytes (COW copy,
+    spill/restore) is bandwidth-bound by construction; everything else
+    compares arithmetic intensity against the machine balance point
+    peak_flops / peak_bw."""
+    if (flops is None or flops <= 0) and hbm_bytes:
+        return "bandwidth-bound"
+    if not flops or not hbm_bytes:
+        return "unknown"
+    peak_tf = float(_flags.flag("FLAGS_peak_tflops") or 0.0)
+    peak_bw = float(_flags.flag("FLAGS_peak_hbm_gbps") or 0.0)
+    if peak_tf <= 0 or peak_bw <= 0:
+        return "unknown"
+    balance = (peak_tf * 1e12) / (peak_bw * 1e9)   # FLOP per HBM byte
+    ai = flops / hbm_bytes
+    return "compute-bound" if ai >= balance else "bandwidth-bound"
+
+
+# -- read side ---------------------------------------------------------------
+def snapshot(top=None):
+    """Point-in-time ledger table: per-program dispatch/sample counts,
+    mean/p50/p95 sampled ms, estimated total device seconds
+    (mean x dispatches), share of the whole ledger's estimated time,
+    trailing-window regression ratio, and the joined efficiency gauges.
+    Rows sort by estimated total time descending; ``top`` keeps the K
+    largest."""
+    with _LOCK:
+        items = [(name, dict(rec), rec["hist"].copy(), list(rec["recent"]))
+                 for name, rec in _LEDGER.items()]
+    rows = []
+    for name, rec, hist, recent in items:
+        sampled = rec["sampled"]
+        mean_s = (rec["time_s"] / sampled) if sampled else None
+        # a sampled row had at least `sampled` dispatches — the floor
+        # matters when the ledger is fed through the _record_sample seam
+        disp = max(rec["dispatches"], sampled)
+        row = {"name": name,
+               "dispatches": rec["dispatches"],
+               "sampled": sampled,
+               "mean_ms": mean_s * 1e3 if mean_s is not None else None,
+               "p50_ms": hist.percentile(50) / 1e6 if sampled else None,
+               "p95_ms": hist.percentile(95) / 1e6 if sampled else None,
+               "est_total_s": (mean_s * disp)
+               if mean_s is not None else 0.0,
+               "regression": _regression(rec, recent)}
+        eff = _efficiency(name, mean_s) if mean_s else {}
+        for k in ("tflops", "mfu", "hbm_gbps", "ai", "roofline"):
+            row[k] = eff.get(k)
+        rows.append(row)
+    rows.sort(key=lambda r: r["est_total_s"], reverse=True)
+    total = sum(r["est_total_s"] for r in rows)
+    for r in rows:
+        r["share"] = (r["est_total_s"] / total) if total > 0 else None
+    if top is not None:
+        rows = rows[:top]
+    return {"sample_every": _SAMPLE[0], "n_programs": len(items),
+            "est_total_s": total, "programs": rows}
+
+
+def _regression(rec, recent):
+    """Trailing-window mean over pre-window baseline mean (None until
+    both windows have samples) — the device_time_regression watchdog's
+    signal."""
+    n_recent = len(recent)
+    n_base = rec["sampled"] - n_recent
+    if n_recent == 0 or n_base <= 0:
+        return None
+    recent_sum = sum(recent)
+    base_sum = rec["time_s"] - recent_sum
+    if base_sum <= 0:
+        return None
+    return (recent_sum / n_recent) / (base_sum / n_base)
+
+
+def summary(top=None) -> str:
+    """Paddle-profiler-style device-time table (the ``memory_summary``
+    sibling for where time goes)."""
+    snap = snapshot(top=top)
+    if not snap["programs"]:
+        return ("(no device-time samples recorded — set "
+                "FLAGS_device_time_sample=N and dispatch)")
+
+    def f(v, spec="{:.3f}", none="-"):
+        return spec.format(v) if v is not None else none
+
+    headers = ("Program", "Disp", "Samp", "Mean(ms)", "P95(ms)", "Share",
+               "TFLOP/s", "MFU", "GB/s", "AI", "Bound")
+    rows = []
+    for r in snap["programs"]:
+        rows.append((
+            r["name"], str(r["dispatches"]), str(r["sampled"]),
+            f(r["mean_ms"]), f(r["p95_ms"]),
+            f(r["share"], "{:.1%}"), f(r["tflops"], "{:.2f}"),
+            f(r["mfu"], "{:.1%}"), f(r["hbm_gbps"], "{:.1f}"),
+            f(r["ai"], "{:.1f}"), r["roofline"] or "-"))
+    widths = [max(len(h), *(len(row[i]) for row in rows))
+              for i, h in enumerate(headers)]
+    fmt = "  ".join("{:<%d}" % w for w in widths)
+    lines = [fmt.format(*headers), fmt.format(*("-" * w for w in widths))]
+    lines.extend(fmt.format(*row) for row in rows)
+    lines.append(f"sample_every={snap['sample_every']}  "
+                 f"est_total={snap['est_total_s']:.3f}s  "
+                 f"programs={snap['n_programs']}")
+    return "\n".join(lines)
+
+
+def bench_block(top=8):
+    """Bench-leg embeddable block: compact per-program share / mean /
+    efficiency numbers keyed by program name.  ``bench_compare.py``
+    flattens it to ``devicetime.programs.<name>.share`` paths and
+    classifies share as lower-is-better per program (attribution)."""
+    snap = snapshot(top=top)
+    progs = {}
+    for r in snap["programs"]:
+        blk = {}
+        for k in ("share", "mean_ms", "p95_ms", "mfu", "tflops",
+                  "hbm_gbps"):
+            if r.get(k) is not None:
+                blk[k] = round(float(r[k]), 6)
+        if r.get("roofline"):
+            blk["roofline"] = r["roofline"]
+        progs[r["name"]] = blk
+    return {"sample_every": snap["sample_every"],
+            "est_total_s": round(snap["est_total_s"], 6),
+            "programs": progs}
+
+
+def reset():
+    """Drop the ledger and re-anchor the sampling sequence so the next
+    note is sample #0 (⌈D/N⌉ becomes exact over a measured window).
+    Counters are NOT touched — they live in the counter registry."""
+    global _SEQ
+    with _LOCK:
+        _LEDGER.clear()
+        _SEQ = itertools.count()
+
+
+# -- on-demand XPlane capture (POST /profile) --------------------------------
+PROFILE_MAX_MS = 60_000
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_SEQ = itertools.count()
+
+
+class ProfileBusy(RuntimeError):
+    """A profiler capture is already in flight (single-flight guard)."""
+
+
+def _start_trace(path):  # test seam (monkeypatched in tests)
+    import jax
+    jax.profiler.start_trace(path)
+
+
+def _stop_trace():  # test seam
+    import jax
+    jax.profiler.stop_trace()
+
+
+def capture_profile(ms, out_dir=None, max_ms=PROFILE_MAX_MS):
+    """Programmatic ``jax.profiler`` start/stop_trace window.
+
+    Single-flight (concurrent calls raise :class:`ProfileBusy` — the ops
+    endpoint maps it to 409) and timeout-guarded: ``ms`` is clamped to
+    [1, ``max_ms``] so a fat-fingered request cannot wedge the profiler
+    open.  Returns ``{"path", "ms"}`` with the XPlane dump directory."""
+    ms = max(1, min(int(ms), int(max_ms)))
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        raise ProfileBusy("profiler capture already in flight")
+    try:
+        if out_dir is None:
+            out_dir = os.path.join(
+                tempfile.gettempdir(),
+                f"ptpu-profile-{os.getpid()}-{next(_PROFILE_SEQ)}")
+        os.makedirs(out_dir, exist_ok=True)
+        _start_trace(out_dir)
+        try:
+            time.sleep(ms / 1000.0)
+        finally:
+            _stop_trace()
+        return {"path": out_dir, "ms": ms}
+    finally:
+        _PROFILE_LOCK.release()
+
+
+# -- flags -------------------------------------------------------------------
+def _on_sample_flag(v):
+    try:
+        n = int(v)
+    except (TypeError, ValueError):
+        n = 0
+    # cache only — an explicit reset() is the ONLY thing that clears the
+    # ledger, so turning sampling off to read results keeps them intact
+    _SAMPLE[0] = max(0, n)
+
+
+_flags.define_flag(
+    "FLAGS_device_time_sample", 0,
+    "Sample every Nth compiled-program dispatch with an explicit "
+    "block-until-ready fence into the device-time ledger "
+    "(profiler.devicetime). 0 (default) = off: dispatch sites pay one "
+    "cached read and no counters move. Each sampled fence ticks "
+    "jit.devicetime.sampled_syncs so sync budgets stay provable.")
+_flags.register_flag_observer("FLAGS_device_time_sample", _on_sample_flag,
+                              call_now=True)
+_flags.define_flag(
+    "FLAGS_peak_hbm_gbps", 0.0,
+    "Accelerator peak HBM bandwidth in GB/s for the roofline "
+    "classification and achieved-bandwidth gauges (0 disables; v5e "
+    "honest peak is 819).")

@@ -123,7 +123,9 @@ def dump(reason, context=None, path=None):
          "counters_delta": {name: movement},     # since startup / clear()
          "histograms": {name: {count,sum,mean,min,max,p50,p95,p99}},
          "events": [{"ts_ns": int, "kind": str, ...fields}, ...],  # oldest first
-         "health": {"admission_level", "alerts", "window"}}  # when plane is on
+         "health": {"admission_level", "alerts", "window"},  # when plane is on
+         "devicetime": {"sample_every", "est_total_s",
+                        "programs": [top-K ledger rows]}}  # when sampled
     """
     from . import metrics as _metrics
     with _LOCK:
@@ -148,6 +150,13 @@ def dump(reason, context=None, path=None):
                 hstate = None
             if hstate is not None:
                 bundle["health"] = _json_safe(hstate)
+        try:
+            from . import devicetime as _devicetime
+            dt = _devicetime.snapshot(top=8)
+            if dt["programs"]:
+                bundle["devicetime"] = _json_safe(dt)
+        except Exception:
+            pass
         if path is None:
             d = dump_dir()
             os.makedirs(d, exist_ok=True)

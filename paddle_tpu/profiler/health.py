@@ -378,6 +378,45 @@ def _wd_kv_tier_occupancy(w, monitor):
     return False, {}
 
 
+def _wd_mfu_collapse(w, monitor):
+    """A dominant program is burning device time at near-zero MFU: the
+    roofline says the chip is idle inside the launch (degenerate shapes,
+    a silent fallback kernel, host-bound dispatch).  Gated on real
+    sampling activity in the window (the ledger only moves when
+    FLAGS_device_time_sample > 0) and on sample volume per program, so a
+    cold first sample cannot flap it."""
+    if w.delta("jit.devicetime.sampled_syncs") <= 0:
+        return False, {}
+    from . import devicetime as _devicetime
+    for row in _devicetime.snapshot(top=8)["programs"]:
+        mfu = row.get("mfu")
+        share = row.get("share") or 0.0
+        if (mfu is not None and row["sampled"] >= 4 and share >= 0.25
+                and mfu < 0.05):
+            return True, {"program": row["name"], "mfu": mfu,
+                          "share": share, "sampled": row["sampled"]}
+    return False, {}
+
+
+def _wd_device_time_regression(w, monitor):
+    """A program's trailing-window mean device time blew past its own
+    baseline (>= 2x): a shape drifted into a slower executable, a cache
+    went cold, or the accelerator is being stolen.  Fires only for
+    programs that carry real share, with enough samples that the
+    baseline mean is meaningful."""
+    if w.delta("jit.devicetime.sampled_syncs") <= 0:
+        return False, {}
+    from . import devicetime as _devicetime
+    for row in _devicetime.snapshot(top=8)["programs"]:
+        reg = row.get("regression")
+        share = row.get("share") or 0.0
+        if (reg is not None and reg >= 2.0 and row["sampled"] >= 12
+                and share >= 0.05):
+            return True, {"program": row["name"], "regression": reg,
+                          "share": share, "sampled": row["sampled"]}
+    return False, {}
+
+
 def _wd_prefetch_stall(w, monitor):
     """Input pipeline starvation: time blocked on data dominates the
     window."""
@@ -418,6 +457,8 @@ def default_watchdogs():
         Watchdog("goodput_accounted", _wd_goodput_accounted),
         Watchdog("spec_acceptance", _wd_spec_acceptance),
         Watchdog("prefetch_stall", _wd_prefetch_stall),
+        Watchdog("mfu_collapse", _wd_mfu_collapse),
+        Watchdog("device_time_regression", _wd_device_time_regression),
     ]
 
 

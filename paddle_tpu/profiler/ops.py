@@ -15,6 +15,13 @@ the full observability plane of a live trainer/engine/fleet:
   GET /alerts             health-plane alert lifecycle + admission level
   GET /slo                per-objective multi-window burn-rate status
   GET /signals            derived windowed signals (rates / p95s / gauges)
+  GET /programs           device-time ledger table: per-program dispatch/
+                          sample counts, mean/p95 ms, share, MFU/roofline
+                          (``devicetime.snapshot``) + AOT program stats
+  POST /profile?ms=N      single-flight programmatic ``jax.profiler``
+                          XPlane capture for N ms (409 while one is in
+                          flight; N clamped to the timeout guard);
+                          returns the dump directory path
 
 Attach whatever the process has: ``OpsServer(fleet=...)`` aggregates
 across fleet replicas via the Router (health, merged latency
@@ -137,6 +144,14 @@ class OpsServer:
                          "kept": _rtrace.kept_ids()}
         return 200, t
 
+    def programs(self):
+        """Device-time ledger table + the AOT per-program stats it joins
+        (``capture_program_stats`` records: FLOPs, HBM bytes, compile s)."""
+        from . import devicetime as _devicetime
+        out = _devicetime.snapshot()
+        out["program_stats"] = _metrics.program_stats()
+        return 200, out
+
     def flight_state(self, tail=50):
         d = _flight.dump_dir()
         bundles = []
@@ -174,13 +189,45 @@ class OpsServer:
             code, obj = self.slo()
         elif path == "/signals":
             code, obj = self.signals()
+        elif path == "/programs":
+            code, obj = self.programs()
         else:
             code, obj = 404, {"error": f"unknown endpoint {path!r}",
                               "endpoints": ["/healthz", "/metrics",
                                             "/goodput", "/traces",
                                             "/traces/<trace_id>",
                                             "/flight", "/alerts",
-                                            "/slo", "/signals"]}
+                                            "/slo", "/signals",
+                                            "/programs",
+                                            "POST /profile?ms="]}
+        return code, "application/json", json.dumps(obj).encode()
+
+    def route_post(self, path):
+        """Dispatch one POST; returns (status, content_type, body_bytes)."""
+        from . import devicetime as _devicetime
+        from urllib.parse import parse_qs, urlsplit
+        parts = urlsplit(path)
+        p = parts.path.rstrip("/") or "/"
+        if p != "/profile":
+            obj = {"error": f"unknown POST endpoint {p!r}",
+                   "endpoints": ["POST /profile?ms="]}
+            return 404, "application/json", json.dumps(obj).encode()
+        q = parse_qs(parts.query)
+        try:
+            ms = int(q.get("ms", ["100"])[0])
+            if ms <= 0:
+                raise ValueError(ms)
+        except (TypeError, ValueError):
+            obj = {"error": f"bad ms={q.get('ms')!r} (want a positive "
+                            "integer of milliseconds)"}
+            return 400, "application/json", json.dumps(obj).encode()
+        try:
+            out = _devicetime.capture_profile(ms)
+            code, obj = 200, out
+        except _devicetime.ProfileBusy as e:
+            code, obj = 409, {"error": str(e)}
+        except Exception as e:
+            code, obj = 500, {"error": repr(e)}
         return code, "application/json", json.dumps(obj).encode()
 
     # -- server lifecycle ----------------------------------------------------
@@ -193,6 +240,18 @@ class OpsServer:
                 try:
                     code, ctype, body = ops.route(self.path)
                 except Exception as e:   # endpoint bug must not kill serving
+                    code, ctype = 500, "application/json"
+                    body = json.dumps({"error": repr(e)}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                try:
+                    code, ctype, body = ops.route_post(self.path)
+                except Exception as e:
                     code, ctype = 500, "application/json"
                     body = json.dumps({"error": repr(e)}).encode()
                 self.send_response(code)

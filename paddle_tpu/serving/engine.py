@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..profiler import counters
+from ..profiler import devicetime as _devicetime
 from ..profiler import flight
 from ..profiler import metrics
 from ..profiler import trace as rtrace
@@ -664,7 +665,9 @@ class LLMEngine:
                                         pf, *pargs)
                     self._maybe_audit(f"serving.prefill[b{bucket}]",
                                       pf, *pargs)
+                    _dt = _devicetime.note(f"serving.prefill[b{bucket}]")
                     kc, vc, tok, new_key = pf(*pargs)
+                    _devicetime.observe(_dt, (kc, vc, tok))
                     ins = self._insert_for(bucket)
                     self._maybe_capture(f"serving.insert[b{bucket}]", ins,
                                         self._ck, self._cv, kc, vc,
@@ -672,8 +675,10 @@ class LLMEngine:
                     self._maybe_audit(f"serving.insert[b{bucket}]", ins,
                                       self._ck, self._cv, kc, vc,
                                       np.int32(slot), donate_argnums=(0, 1))
+                    _dt = _devicetime.note(f"serving.insert[b{bucket}]")
                     self._ck, self._cv = ins(
                         self._ck, self._cv, kc, vc, np.int32(slot))
+                    _devicetime.observe(_dt, (self._ck, self._cv))
                 if tr is not None:
                     tr.add_span("prefill", t0_tr, time.perf_counter_ns(),
                                 bucket=bucket, tokens=T)
@@ -719,7 +724,9 @@ class LLMEngine:
             self._maybe_capture("serving.decode", dec, *dargs)
             self._maybe_audit("serving.decode", dec, *dargs,
                               donate_argnums=(1, 2))
+            _dt = _devicetime.note("serving.decode")
             nxt, self._ck, self._cv, new_keys = dec(*dargs)
+            _devicetime.observe(_dt, nxt)
             nxt = np.asarray(nxt)
         if tr_on:
             t1_tr = time.perf_counter_ns()

@@ -74,6 +74,7 @@ from collections import deque
 import numpy as np
 
 from ..profiler import counters
+from ..profiler import devicetime as _devicetime
 from ..profiler import flight
 from ..profiler import health as _health
 from ..profiler import trace as rtrace
@@ -1147,4 +1148,11 @@ class ServingFleet:
             counters.set_gauge("serving.fleet.spec_acceptance", acc)
         if self.autoscaler is not None:
             out["autoscale"] = self.autoscaler.summary()
+        # device-time & efficiency plane roll-up: the ledger is process-
+        # global (all replicas share the dispatch sites), so the fleet
+        # view is just its snapshot — present whenever sampling is (or
+        # was) on and left rows behind
+        dt = _devicetime.snapshot(top=16)
+        if dt["programs"] or dt["sample_every"]:
+            out["devicetime"] = dt
         return out

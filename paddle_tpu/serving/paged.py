@@ -54,6 +54,7 @@ import numpy as np
 
 from ..kernels import paged_attention as _pa
 from ..profiler import counters
+from ..profiler import devicetime as _devicetime
 from ..profiler import flight
 from ..profiler import metrics
 from ..profiler import trace as rtrace
@@ -483,11 +484,13 @@ class PagedLLMEngine(LLMEngine):
         copy, so the device block is reusable the moment this
         returns."""
         sp = self._pspill()
+        _dt = _devicetime.note(f"serving.kv.{self._prog_key('spill_block')}")
         if self.kv_dtype:
             out = sp(self._pk, self._pv, self._sk, self._sv,
                      np.int32(block))
         else:
             out = sp(self._pk, self._pv, np.int32(block))
+        _devicetime.observe(_dt, out)
         bufs = self._host_tier.acquire(self._host_spec)
         for dst, src in zip(bufs, out):
             np.copyto(dst, np.asarray(src))
@@ -499,6 +502,8 @@ class PagedLLMEngine(LLMEngine):
         by the backend (CPU jax aliases host arrays zero-copy): callers
         must sync (``jax.block_until_ready``) before recycling them."""
         rs = self._prestore()
+        _dt = _devicetime.note(
+            f"serving.kv.{self._prog_key('restore_block')}")
         if self.kv_dtype:
             (self._pk, self._pv, self._sk, self._sv) = rs(
                 self._pk, self._pv, self._sk, self._sv, *bufs,
@@ -506,6 +511,7 @@ class PagedLLMEngine(LLMEngine):
         else:
             self._pk, self._pv = rs(self._pk, self._pv, *bufs,
                                     np.int32(block))
+        _devicetime.observe(_dt, (self._pk, self._pv))
 
     def _drop_host_key(self, key):
         """Reconcile bookkeeping for a key the tier LRU-discarded: a
@@ -776,14 +782,17 @@ class PagedLLMEngine(LLMEngine):
                 else:
                     cargs = (self._pk, self._pv, *scalars)
                     dn = (0, 1)
-                self._maybe_capture("serving.kv.copy_block", cp, *cargs)
-                self._maybe_audit("serving.kv.copy_block", cp, *cargs,
+                cow_name = f"serving.kv.{self._prog_key('copy_block')}"
+                self._maybe_capture(cow_name, cp, *cargs)
+                self._maybe_audit(cow_name, cp, *cargs,
                                   donate_argnums=dn)
                 # the reservation (pool alloc + table + COW adopt) must be
                 # atomic w.r.t. concurrent cancel/router stats, so this one
                 # bounded block-copy dispatch stays under the lock
+                _dt = _devicetime.note(cow_name)
                 # ptlint: disable=PT005 reason="COW adopt is part of the atomic reservation; a bounded one-block copy, not a per-token dispatch"
                 out = cp(*cargs)
+                _devicetime.observe(_dt, out)
                 if self.kv_dtype:
                     self._pk, self._pv, self._sk, self._sv = out
                 else:
@@ -879,14 +888,16 @@ class PagedLLMEngine(LLMEngine):
             else:
                 pargs = (*head, self._pk, self._pv, *tail)
                 dn = (5, 6)
-            self._maybe_capture(f"serving.prefill_paged[c{C}]", pf, *pargs)
-            self._maybe_audit(f"serving.prefill_paged[c{C}]", pf, *pargs,
-                              donate_argnums=dn)
+            pname = f"serving.{self._prog_key('prefill_paged')}[c{C}]"
+            self._maybe_capture(pname, pf, *pargs)
+            self._maybe_audit(pname, pf, *pargs, donate_argnums=dn)
+            _dt = _devicetime.note(pname)
             if self.kv_dtype:
                 (self._pk, self._pv, self._sk, self._sv, tok,
                  new_key) = pf(*pargs)
             else:
                 self._pk, self._pv, tok, new_key = pf(*pargs)
+            _devicetime.observe(_dt, tok)
         if tr is not None:
             tr.add_span("prefill.chunk", t0_tr, time.perf_counter_ns(),
                         chunk=C, start=start, take=take_n)
@@ -974,14 +985,16 @@ class PagedLLMEngine(LLMEngine):
             else:
                 dargs = (self._w, self._pk, self._pv, *tail)
                 dn = (1, 2)
-            self._maybe_capture("serving.decode_paged", dec, *dargs)
-            self._maybe_audit("serving.decode_paged", dec, *dargs,
-                              donate_argnums=dn)
+            dname = f"serving.{self._prog_key('decode_paged')}"
+            self._maybe_capture(dname, dec, *dargs)
+            self._maybe_audit(dname, dec, *dargs, donate_argnums=dn)
+            _dt = _devicetime.note(dname)
             if self.kv_dtype:
                 (nxt, self._pk, self._pv, self._sk, self._sv,
                  new_keys) = dec(*dargs)
             else:
                 nxt, self._pk, self._pv, new_keys = dec(*dargs)
+            _devicetime.observe(_dt, nxt)
             nxt = np.asarray(nxt)
         if tr_on:
             t1_tr = time.perf_counter_ns()
@@ -1138,15 +1151,16 @@ class PagedLLMEngine(LLMEngine):
                     margs = (self._pk, self._pv, src._pk, src._pv,
                              *scalars)
                     dn = (0, 1)
-                self._maybe_capture("serving.kv.migrate_blocks", mg,
-                                    *margs)
-                self._maybe_audit("serving.kv.migrate_blocks", mg,
-                                  *margs, donate_argnums=dn)
+                mg_name = f"serving.kv.{self._prog_key('migrate_blocks')}"
+                self._maybe_capture(mg_name, mg, *margs)
+                self._maybe_audit(mg_name, mg, *margs, donate_argnums=dn)
                 # the adopt (dest prefix retains + alloc + table install
                 # + block copy) must be atomic w.r.t. this engine's
                 # scheduler — same contract as the COW adopt in _reserve
+                _dt = _devicetime.note(mg_name)
                 # ptlint: disable=PT005 reason="migration adopt is one bounded block-table copy inside the atomic reservation, not a per-token dispatch"
                 out = mg(*margs)
+                _devicetime.observe(_dt, out)
                 if self.kv_dtype:
                     self._pk, self._pv, self._sk, self._sv = out
                 else:

@@ -64,6 +64,7 @@ import numpy as np
 
 from ..kernels import paged_attention as _pa
 from ..profiler import counters
+from ..profiler import devicetime as _devicetime
 from ..profiler import flight
 from ..profiler import trace as rtrace
 from ..profiler.host_tracer import span
@@ -437,14 +438,19 @@ class SpeculativeLLMEngine(PagedLLMEngine):
             else:
                 dargs = (*head, self._dk, self._dv)
                 dn = (5, 6)
-            self._maybe_capture(f"serving.spec.draft_prefill[c{C}]", df,
-                                *dargs)
-            self._maybe_audit(f"serving.spec.draft_prefill[c{C}]", df,
-                              *dargs, donate_argnums=dn)
+            # program name == the _model_programs cache key (+ chunk
+            # bucket), so devicetime/telemetry rows join the executable
+            # that actually ran
+            dname = (f"{self._prog_key('serving.draft_prefill_paged')}"
+                     f"[c{C}]")
+            self._maybe_capture(dname, df, *dargs)
+            self._maybe_audit(dname, df, *dargs, donate_argnums=dn)
+            _dt = _devicetime.note(dname)
             if self.kv_dtype:
                 self._dk, self._dv, self._dsk, self._dsv = df(*dargs)
             else:
                 self._dk, self._dv = df(*dargs)
+            _devicetime.observe(_dt, self._dk)
         counters.inc("serving.spec.draft_prefill_chunks")
         return start + take_n
 
@@ -625,11 +631,14 @@ class SpeculativeLLMEngine(PagedLLMEngine):
                 dargs = (*head, jnp.asarray(bt_eff), cur,
                          jnp.asarray(pos_j), dkeys, dosample, temp, topk,
                          topp)
+                dname = self._prog_key("serving.draft_paged")
                 if j == 0:
-                    self._maybe_capture("serving.spec.draft", df, *dargs)
-                    self._maybe_audit("serving.spec.draft", df, *dargs,
+                    self._maybe_capture(dname, df, *dargs)
+                    self._maybe_audit(dname, df, *dargs,
                                       donate_argnums=dn)
+                _dt = _devicetime.note(dname)
                 out = df(*dargs)
+                _devicetime.observe(_dt, out)
                 if self.kv_dtype:
                     (cur, qrow, self._dk, self._dv, self._dsk, self._dsv,
                      dkeys) = out
@@ -648,10 +657,12 @@ class SpeculativeLLMEngine(PagedLLMEngine):
             vargs = (*vhead, jnp.asarray(bt_eff), jnp.asarray(pos0),
                      jnp.asarray(nv), jnp.asarray(self._keys), dosample,
                      temp, topk, topp, *ts, *qs)
-            self._maybe_capture("serving.spec.verify", vf, *vargs)
-            self._maybe_audit("serving.spec.verify", vf, *vargs,
-                              donate_argnums=vdn)
+            vname = self._prog_key(f"serving.verify_paged[k{self.spec_k}]")
+            self._maybe_capture(vname, vf, *vargs)
+            self._maybe_audit(vname, vf, *vargs, donate_argnums=vdn)
+            _dt = _devicetime.note(vname)
             out = vf(*vargs)
+            _devicetime.observe(_dt, out)
             if self.kv_dtype:
                 (emit, n_emit, self._pk, self._pv, self._sk, self._sv,
                  new_keys) = out

@@ -36,6 +36,8 @@ Usage::
     python scripts/bench_compare.py                   # newest vs history
     python scripts/bench_compare.py --candidate out.json --json
     python scripts/bench_compare.py --tol 0.15 --tol-for mfu=0.05
+    python scripts/bench_compare.py --attribute       # per-program
+                                                      # device-time diff
 
 Exit status: 0 clean, 1 regression(s), 2 not enough data to compare.
 """
@@ -60,11 +62,26 @@ _LOWER_RE = re.compile(
 _SKIP_RE = re.compile(
     r"(^|\.)(count|spread_frac|n_params|spilled_blocks|restored_blocks"
     r"|host_buf_reuse|readopted|sheds)($|\.)")
+# per-program device-time ledger blocks embedded by bench legs
+# (devicetime.programs.<name>.<field>): a program's share of device time
+# and its mean/p95 latency must not RISE, its MFU must not DROP;
+# everything else in the block (sample_every, est_total_s, tflops — all
+# window-length- or host-load-dependent) is informational
+_DT_RE = re.compile(r"(^|\.)devicetime\.")
+_DT_PROG_PREFIX = "devicetime.programs."
 
 
 def classify(metric):
     """'higher' / 'lower' / None (informational) for one dotted path."""
     if _SKIP_RE.search(metric):
+        return None
+    if _DT_RE.search(metric):
+        if _DT_PROG_PREFIX not in metric:
+            return None
+        if metric.endswith(".share") or metric.endswith("_ms"):
+            return "lower"
+        if metric.endswith(".mfu"):
+            return "higher"
         return None
     if any(tok in metric for tok in _HIGHER):
         return "higher"
@@ -185,6 +202,56 @@ def compare(history, candidate, default_tol, overrides):
     return regressions, checks
 
 
+def _dt_shares(metrics):
+    """``{program: share}`` from one leg's flattened metric paths."""
+    out = {}
+    for m, v in metrics.items():
+        if (m.startswith(_DT_PROG_PREFIX)
+                and m.endswith(".share")):
+            out[m[len(_DT_PROG_PREFIX):-len(".share")]] = v
+    return out
+
+
+def attribute(prior, candidate, regressions):
+    """Per-leg device-time attribution: for every candidate leg carrying
+    a devicetime block, diff each program's share of device time against
+    the most recent prior run that also carries one, and rank the
+    movers.  A regressed leg is thereby NAMED the program(s) whose share
+    moved — the diagnosis the perf gate hands to the real-chip
+    campaign."""
+    regressed_legs = {r["leg"] for r in regressions}
+    out = []
+    for leg, metrics in sorted(candidate["legs"].items()):
+        shares = _dt_shares(metrics)
+        if not shares:
+            continue
+        base, base_path = None, None
+        for run in reversed(prior):
+            pm = run["legs"].get(leg)
+            if pm:
+                ps = _dt_shares(pm)
+                if ps:
+                    base, base_path = ps, run["path"]
+                    break
+        movers = []
+        for prog in set(shares) | set(base or {}):
+            c = shares.get(prog, 0.0)
+            b = (base or {}).get(prog, 0.0)
+            movers.append({"program": prog, "share": round(c, 4),
+                           "prior_share": round(b, 4),
+                           "moved": round(c - b, 4)})
+        movers.sort(key=lambda m: abs(m["moved"]), reverse=True)
+        dominant = max(movers, key=lambda m: m["share"])
+        out.append({"leg": leg,
+                    "baseline_run": (os.path.basename(base_path)
+                                     if base_path else None),
+                    "regressed": leg in regressed_legs,
+                    "dominant": dominant["program"],
+                    "dominant_share": dominant["share"],
+                    "movers": movers[:5]})
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="BENCH trajectory perf-regression gate")
@@ -203,6 +270,10 @@ def main(argv=None):
                          "repeatable")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
+    ap.add_argument("--attribute", action="store_true",
+                    help="per-program device-time attribution: name the "
+                         "program(s) whose share of device time moved, "
+                         "per leg (needs devicetime blocks in the runs)")
     args = ap.parse_args(argv)
 
     overrides = {}
@@ -251,6 +322,10 @@ def main(argv=None):
               "checks": checks,
               "regressions": regressions,
               "value": len(regressions)}
+    attribution = (attribute(prior, candidate, regressions)
+                   if args.attribute else None)
+    if attribution is not None:
+        report["attribution"] = attribution
     if args.json:
         print(json.dumps(report, indent=1))
     else:
@@ -265,6 +340,23 @@ def main(argv=None):
                   f"{c['limit']:g})")
         if not checks:
             print("  (no overlapping gated metrics)")
+        if attribution is not None:
+            print("device-time attribution:")
+            if not attribution:
+                print("  (no devicetime blocks in the candidate legs)")
+            for a in attribution:
+                base = (f"vs {a['baseline_run']}" if a["baseline_run"]
+                        else "no prior devicetime block")
+                mark = "REGRESSED " if a["regressed"] else ""
+                print(f"  {mark}{a['leg']} ({base}): dominant program "
+                      f"{a['dominant']} at {a['dominant_share']:.1%} of "
+                      "device time")
+                for m in a["movers"]:
+                    if m["moved"]:
+                        print(f"    {m['program']}: share "
+                              f"{m['prior_share']:.1%} -> "
+                              f"{m['share']:.1%} "
+                              f"({m['moved']:+.1%})")
         print(f"{len(regressions)} regression(s)")
     return 1 if regressions else 0
 

@@ -103,6 +103,17 @@ does not); and a ``kv_spill_drop`` fault mid-restore must degrade to a
 deterministic cache-miss replay with identical tokens and a reconciled
 block pool.
 
+A twelfth phase gates the device-time ledger
+(``profiler.devicetime``): with ``FLAGS_device_time_sample=0`` a fresh
+slot + paged + speculative workload must move ZERO ``jit.devicetime.*``
+/ ``program.*`` state and be counter-identical on the parity keys to
+the sampling-ON run of the identical workload; with sample=4 the
+measured window must pay EXACTLY ``ceil(dispatches / 4)`` sampled
+block-until-ready fences (``jit.devicetime.sampled_syncs``) with token
+identity and zero retraces, and the ledger it leaves behind must carry
+MFU/roofline gauges that survive ``GET /programs`` and a
+``bench_compare.py --attribute`` run that names the dominant program.
+
 Prints one JSON line; raises AssertionError on any violation.  Wired as a
 tier-1 test via tests/test_profiler.py.  Run directly:
 ``python scripts/check_counters.py``.
@@ -1350,6 +1361,141 @@ def run():
             violations[f"audit-fixture:{want_rule}"] = (got_rules,
                                                         want_rule)
 
+    # ---- devicetime gate: the device-time ledger is zero-overhead OFF
+    # (sample=0 moves NO jit.devicetime.* / program.* state and the run
+    # is counter-identical on the parity keys vs the ON run of the same
+    # fresh slot/paged/spec workload); ON (sample=4) pays EXACTLY the
+    # budgeted fences — sampled_syncs == ceil(dispatches / 4) over a
+    # window anchored by devicetime.reset() — with token identity, zero
+    # retraces, and a populated ledger whose MFU/roofline gauges survive
+    # GET /programs and a bench_compare --attribute run that names the
+    # dominant program.
+    import contextlib
+    import importlib.util
+    import io as _io
+    import tempfile
+
+    from paddle_tpu.profiler import devicetime as pdt
+
+    def dt_workloads():
+        """Fresh slot + paged + spec engines over the pq workload; warm
+        first so every compile (and, under sampling, its first noted
+        dispatches) stays outside the measured window, which is anchored
+        by an explicit ledger reset."""
+        paddle.seed(0)
+        e7 = LLMEngine(smodel, max_slots=2, max_seq_len=32, min_bucket=4)
+        p7 = pq_engine()
+        s7 = spec_engine()
+        for eng7 in (e7, p7, s7):
+            pq_run(eng7)                      # warm: compiles cached
+        pdt.reset()                           # anchor the sample window
+        b = counters.snapshot()
+        outs = [pq_run(eng7) for eng7 in (e7, p7, s7)]
+        return counters.delta(b), outs
+
+    dt_off, dt_off_tokens = dt_workloads()
+    dt_off_moved = {k: v for k, v in dt_off.items()
+                    if k.startswith(("jit.devicetime.", "program.")) and v}
+    if dt_off_moved:
+        violations["devicetime-off:counters"] = (dt_off_moved, {})
+    if dt_off_tokens[1:] != [base_greedy, base_greedy]:
+        violations["devicetime-off:identity"] = (dt_off_tokens[1:],
+                                                 base_greedy)
+
+    # AOT-capture FLOPs/HBM bytes for every program name once (telemetry
+    # pass), then sample with telemetry back OFF but peaks kept so the
+    # ledger's efficiency join has both sides to work with.
+    dt_saved = {k: pflags.flag(k) for k in
+                ("FLAGS_peak_tflops", "FLAGS_peak_hbm_gbps",
+                 "FLAGS_device_telemetry", "FLAGS_device_time_sample")}
+    pflags.set_flags({"FLAGS_device_telemetry": True,
+                      "FLAGS_peak_tflops": 197.0,
+                      "FLAGS_peak_hbm_gbps": 819.0})
+    try:
+        dt_workloads()
+        pflags.set_flags({"FLAGS_device_telemetry": False,
+                          "FLAGS_device_time_sample": 4})
+        dt_on, dt_on_tokens = dt_workloads()
+    finally:
+        # telemetry + sampling restored here; the PEAK flags stay live
+        # through the reads below (the efficiency join reads them at
+        # snapshot time) and are restored at the end of the phase
+        pflags.set_flags({
+            "FLAGS_device_telemetry": dt_saved["FLAGS_device_telemetry"],
+            "FLAGS_device_time_sample":
+                dt_saved["FLAGS_device_time_sample"]})
+    for k in PARITY_KEYS:
+        if dt_on.get(k, 0) != dt_off.get(k, 0):
+            violations[f"devicetime-parity:{k}"] = (dt_on.get(k, 0),
+                                                    dt_off.get(k, 0))
+    if dt_on_tokens != dt_off_tokens:
+        violations["devicetime-on:identity"] = (dt_on_tokens,
+                                                dt_off_tokens)
+    dt_disp = dt_on.get("jit.devicetime.dispatches", 0)
+    dt_syncs = dt_on.get("jit.devicetime.sampled_syncs", 0)
+    if not dt_disp:
+        violations["devicetime-on:dispatches"] = (dt_disp, ">0")
+    if dt_syncs != -(-dt_disp // 4):
+        violations["devicetime-on:sync_budget"] = (
+            dt_syncs, f"ceil({dt_disp}/4)")
+
+    # the ledger the measured ON window left behind (the flag observer
+    # never resets it): rows present, at least one with a joined MFU
+    dt_snap = pdt.snapshot()
+    if not dt_snap["programs"]:
+        violations["devicetime:ledger"] = (0, ">=1 program row")
+    dt_mfu_rows = [p["name"] for p in dt_snap["programs"]
+                   if p.get("mfu") is not None]
+    if not dt_mfu_rows:
+        violations["devicetime:mfu_rows"] = ([], ">=1 row with MFU")
+
+    # the same table over the wire
+    with OpsServer() as dsrv:
+        with urllib.request.urlopen(dsrv.url("/programs"),
+                                    timeout=10) as r:
+            dt_http = json.loads(r.read())
+    if len(dt_http.get("programs") or []) != len(dt_snap["programs"]):
+        violations["devicetime:/programs"] = (
+            len(dt_http.get("programs") or []), len(dt_snap["programs"]))
+    if not [p for p in dt_http.get("programs") or []
+            if p.get("mfu") is not None]:
+        violations["devicetime:/programs-mfu"] = ([], ">=1 row with MFU")
+
+    # per-program regression attribution: a synthetic candidate run that
+    # regresses throughput while the dominant program's device-time
+    # share grows must be attributed to that program by name
+    dt_block = pdt.bench_block(top=8)
+    dt_dominant = max(dt_block["programs"],
+                      key=lambda n: dt_block["programs"][n].get("share")
+                      or 0.0)
+    bc_spec = importlib.util.spec_from_file_location(
+        "bench_compare", os.path.join(os.path.dirname(__file__),
+                                      "bench_compare.py"))
+    bc_mod = importlib.util.module_from_spec(bc_spec)
+    bc_spec.loader.exec_module(bc_mod)
+    with tempfile.TemporaryDirectory() as td:
+        cand_block = json.loads(json.dumps(dt_block))
+        crow = cand_block["programs"][dt_dominant]
+        crow["share"] = min(1.0, (crow.get("share") or 0.5) + 0.2)
+        for i, legs in ((1, {"paged": {"tokens_per_sec": 100.0,
+                                       "devicetime": dt_block}}),
+                        (2, {"paged": {"tokens_per_sec": 70.0,
+                                       "devicetime": cand_block}})):
+            with open(os.path.join(td, f"BENCH_r{i:02d}.json"), "w") as f:
+                json.dump({"rc": 0, "parsed": {"legs": legs}}, f)
+        buf = _io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            bc_mod.main(["--glob", os.path.join(td, "BENCH_r0*.json"),
+                         "--attribute"])
+        dt_attr_out = buf.getvalue()
+    if dt_dominant not in dt_attr_out:
+        violations["devicetime:attribution"] = (
+            dt_attr_out.splitlines()[-6:], dt_dominant)
+    pflags.set_flags({"FLAGS_peak_tflops": dt_saved["FLAGS_peak_tflops"],
+                      "FLAGS_peak_hbm_gbps":
+                          dt_saved["FLAGS_peak_hbm_gbps"]})
+    pdt.reset()
+
     result = {"metric": "steady_state_counter_violations",
               "value": len(violations),
               "unit": f"violations/{MEASURE} steps "
@@ -1415,7 +1561,14 @@ def run():
                                 "audits": audits_run,
                                 "findings": audit_delta.get(
                                     "analysis.findings", 0),
-                                "fixtures": fixture_got}}
+                                "fixtures": fixture_got},
+              "devicetime": {"off": _pick(dt_off), "on": _pick(dt_on),
+                             "off_moved": dt_off_moved,
+                             "dispatches": dt_disp,
+                             "sampled_syncs": dt_syncs,
+                             "ledger_programs": len(dt_snap["programs"]),
+                             "mfu_rows": dt_mfu_rows[:4],
+                             "attribution_dominant": dt_dominant}}
     print(json.dumps(result))
     if violations:
         raise AssertionError(

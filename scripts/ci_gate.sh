@@ -8,7 +8,11 @@
 # and the tiering phase (host-RAM KV tier under an oversubscribed pool:
 # spill/restore token identity for greedy AND seeded sampling, zero
 # steady-state retraces/syncs, flat host arena once the buffer reuse
-# pool is warm, and kv_spill_drop chaos degrading to a cache miss).
+# pool is warm, and kv_spill_drop chaos degrading to a cache miss),
+# and the devicetime phase (sample=0 byte-identical OFF parity;
+# sample=4 pays exactly ceil(dispatches/4) fences with token identity
+# and a ledger whose MFU/roofline gauges survive GET /programs and
+# bench_compare --attribute).
 #
 # Usage: scripts/ci_gate.sh        (from anywhere; cd's to the repo root)
 set -euo pipefail
@@ -30,7 +34,7 @@ elif [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
-echo "== ci_gate: steady-state counter invariants (incl. disagg, tiering) =="
+echo "== ci_gate: steady-state counter invariants (incl. disagg, tiering, devicetime) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
     python scripts/check_counters.py
 

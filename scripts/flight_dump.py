@@ -9,7 +9,9 @@ Usage::
 
 Renders the bundle sections written by ``paddle_tpu.profiler.flight.dump``
 — reason/context header, active span stack, the health plane's alert set
-and last window (when FLAGS_health was on at dump time), the counters
+and last window (when FLAGS_health was on at dump time), the device-time
+ledger top-K (program share / mean / p95 / MFU / roofline, when
+FLAGS_device_time_sample captured anything), the counters
 that MOVED since startup (full snapshot stays in the JSON), histogram
 percentiles, and the event ring tail with relative timestamps.  ``--events N`` bounds the tail
 (default 40; 0 = all); ``--raw`` re-emits the bundle as indented JSON.
@@ -115,6 +117,26 @@ def render(path, max_events=40, raw=False, out=sys.stdout):
                 w(f"    {k:<40} +{_fmt_val(win['delta'][k])}\n")
             for k in sorted(win.get("p95") or {}):
                 w(f"    {k:<40} p95 {_fmt_val(win['p95'][k])}\n")
+
+    dt = bundle.get("devicetime")
+    if dt and dt.get("programs"):
+        progs = dt["programs"]
+        w(f"\n-- device time (sample_every={dt.get('sample_every')}, "
+          f"est_total={dt.get('est_total_s', 0):.3f}s, "
+          f"top {len(progs)}):\n")
+        w(f"  {'program':<42}{'share':>7}{'mean':>10}{'p95':>10}"
+          f"{'mfu':>7}{'bound':>17}\n")
+        for p in progs:
+            share = p.get("share")
+            mean = p.get("mean_ms")
+            p95 = p.get("p95_ms")
+            mfu = p.get("mfu")
+            w(f"  {p.get('name', '?'):<42}"
+              f"{(f'{share:.1%}' if share is not None else '-'):>7}"
+              f"{(f'{mean:.3f}ms' if mean is not None else '-'):>10}"
+              f"{(f'{p95:.3f}ms' if p95 is not None else '-'):>10}"
+              f"{(f'{mfu:.1%}' if mfu is not None else '-'):>7}"
+              f"{(p.get('roofline') or '-'):>17}\n")
 
     moved = {k: v for k, v in (bundle.get("counters_delta") or {}).items()
              if v}
