@@ -65,6 +65,15 @@ Legs (perf round 5):
   dispatches == steps/K on the mesh path, and ≥70% dp scaling efficiency
   on real chips (forced-host CPU "devices" share cores, so the scaling
   number is informational there).
+- gpt760m_servemp (tensor-parallel serving leg, PTPU_BENCH=servemp with
+  PTPU_MESH=mp2): the paged engine run mesh-native over the StateArena
+  (``LLMEngine(mesh=...)`` — KV pool head-sharded, Megatron-sharded
+  weights, replicated block-table/sampling operands, in-graph collectives
+  only) against the unsharded engine at EQUAL admitted capacity.
+  Reports decode tok/s/chip and per-chip KV-pool / weight HBM bytes;
+  gates token identity, zero steady retraces, per-chip KV+weight bytes
+  <= 0.6x the single-chip figure, and decode tok/s >= 0.9x unsharded
+  (the 760m flagship on TPU; a 125m CPU-fallback twin off-TPU).
 Every training leg embeds a compact "metrics" block (loss / grad-norm /
 tok/s / step-time / MFU stats from the zero-sync in-graph MetricsLogger
 accumulators) plus a "goodput" block (the profiler.goodput wall-clock
@@ -83,7 +92,7 @@ FLAGS_device_time_sample ledger, captured in a short UNTIMED post-window
 pass so the sampling fences never touch a gated number) —
 ``bench_compare.py --attribute`` diffs these shares to name the program
 behind any regression.
-Set PTPU_BENCH=125m|760m|serve|paged|paged_q|tiered|spec|ckpt|fleet|disagg|mesh|mesh760m
+Set PTPU_BENCH=125m|760m|serve|paged|paged_q|tiered|spec|ckpt|fleet|disagg|mesh|mesh760m|servemp
 to run a single leg.  PTPU_FUSED_STEPS sets the fused window length K (default 4; 1
 disables the fused leg).  PTPU_MESH picks the mesh leg's axis degrees.
 """
@@ -1415,6 +1424,121 @@ def _parse_mesh_degrees(spec):
     return degrees or {"dp": 2}
 
 
+def _run_servemp_leg(cfg, mp, n_requests=8, max_new=24, max_slots=8,
+                     min_bucket=8, block_size=16, prefill_chunk=128,
+                     seed=0, max_hbm_frac=0.6, min_tps_frac=0.9):
+    """Tensor-parallel paged serving duel: an mp-way mesh engine
+    (``LLMEngine(mesh=...)`` — KV pool head-sharded, Megatron-sharded
+    weights, replicated operand block tables, in-graph collectives only)
+    vs the unsharded engine at EQUAL admitted capacity (same slots, same
+    block pool).  Gates: token identity, zero steady retraces on the
+    mesh path, per-chip KV-pool + weight HBM bytes <= ``max_hbm_frac``
+    of the single-chip figure, and decode tok/s within
+    ``1 - min_tps_frac`` of the unsharded baseline (honest on real
+    chips; on the forced-host CPU fallback the "chips" share cores, so
+    the throughput gate is informational there).  Returns the leg
+    dict."""
+    import jax
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.profiler import counters
+    from paddle_tpu.serving import LLMEngine
+
+    paddle.seed(seed)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(seed)
+    S = cfg.max_seq_len
+    # decode-heavy mix: short prompts, long generations — the regime
+    # tensor parallelism serves (per-token weight sweep dominates)
+    lens = [int(rng.randint(max(2, S // 32), max(3, S // 8)))
+            for _ in range(n_requests)]
+    prompts = [rng.randint(0, cfg.vocab_size, size=n).tolist()
+               for n in lens]
+
+    def build(mesh=None):
+        return LLMEngine(model, max_slots=max_slots, max_seq_len=S,
+                         min_bucket=min_bucket, kv_layout="paged",
+                         block_size=block_size,
+                         prefill_chunk=prefill_chunk, mesh=mesh)
+
+    def serve(eng):
+        hs = [eng.add_request(p, max_new_tokens=max_new) for p in prompts]
+        while not all(h.is_finished for h in hs):
+            eng.step()
+        return [list(map(int, h.tokens)) for h in hs]
+
+    def timed(eng, rounds=3):
+        best, toks = 0.0, None
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            toks = serve(eng)
+            tps = (n_requests * max_new
+                   / max(time.perf_counter() - t0, 1e-9))
+            best = max(best, tps)
+        return toks, best
+
+    base = build()
+    base_tokens = serve(base)    # warm: full prefills
+    serve(base)                  # warm: prefix-cached re-prefills
+    _, base_tps = timed(base)
+    base_stats = base.stats()
+    base_bytes = (base_stats["kv_pool_bytes_per_chip"]
+                  + base_stats["weight_bytes_per_chip"])
+
+    mesh = Mesh(np.array(jax.devices()[:mp]).reshape(mp), ("mp",))
+    sh = build(mesh)
+    sh_tokens = serve(sh)        # warm: full prefills ([mp] programs)
+    serve(sh)                    # warm: prefix-cached re-prefills
+    before = counters.snapshot()
+    sh_tokens2, sh_tps = timed(sh)
+    delta = counters.delta(before)
+    sh_stats = sh.stats()
+    sh_bytes = (sh_stats["kv_pool_bytes_per_chip"]
+                + sh_stats["weight_bytes_per_chip"])
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    leg = {"mesh": f"mp{mp}",
+           "cpu_fallback": not on_tpu,
+           "requests": n_requests,
+           "max_new_tokens": max_new,
+           "decode_tokens_per_sec": round(sh_tps, 2),
+           "decode_tokens_per_sec_per_chip": round(sh_tps / mp, 2),
+           "unsharded_tokens_per_sec": round(base_tps, 2),
+           "tps_frac_vs_unsharded": round(sh_tps / max(base_tps, 1e-9), 4),
+           "kv_pool_bytes_per_chip": sh_stats["kv_pool_bytes_per_chip"],
+           "weight_bytes_per_chip": sh_stats["weight_bytes_per_chip"],
+           "unsharded_kv_pool_bytes": base_stats["kv_pool_bytes_per_chip"],
+           "unsharded_weight_bytes": base_stats["weight_bytes_per_chip"],
+           "per_chip_hbm_frac": round(sh_bytes / max(base_bytes, 1), 4),
+           "outputs_match_unsharded": (sh_tokens == base_tokens
+                                       and sh_tokens2 == base_tokens),
+           "steady_retraces": delta.get("serving.retraces", 0),
+           "spec_degraded": counters.get("serving.mesh.spec_degraded"),
+           "kv_shard_shape": list(sh.arena.shard_shape("pool_k"))}
+    if not leg["outputs_match_unsharded"]:
+        raise AssertionError(
+            f"servemp leg: mp{mp} engine diverged from unsharded: {leg}")
+    if leg["steady_retraces"]:
+        raise AssertionError(
+            f"servemp leg: {leg['steady_retraces']} steady retraces on "
+            f"the mesh path: {leg}")
+    if leg["per_chip_hbm_frac"] > max_hbm_frac:
+        raise AssertionError(
+            f"servemp leg: per-chip KV+weight bytes "
+            f"{leg['per_chip_hbm_frac']:.3f}x of unsharded exceed the "
+            f"{max_hbm_frac:.2f}x ceiling: {leg}")
+    if leg["tps_frac_vs_unsharded"] < min_tps_frac:
+        raise AssertionError(
+            f"servemp leg: mesh decode tok/s "
+            f"{leg['tps_frac_vs_unsharded']:.3f}x of unsharded below the "
+            f"{min_tps_frac:.2f}x floor: {leg}")
+    del base, sh, model
+    return leg
+
+
 def _run_mesh_leg(cfg, batch_per_chip, seq, iters, rounds, degrees,
                   fused_steps=1, peak=197e12, min_scaling=None):
     """Multi-chip SPMD leg: the same fused training loop run mesh-native
@@ -1587,6 +1711,33 @@ def main():
 
     fused_k = int(os.environ.get("PTPU_FUSED_STEPS", "4"))
 
+    if not on_tpu and os.environ.get("PTPU_BENCH") == "servemp":
+        # tensor-parallel serving twin, runnable in isolation off-TPU:
+        # same gates as the flagship (token identity, zero steady
+        # retraces, per-chip KV+weight HBM <= 0.6x single-chip, decode
+        # tok/s >= 0.9x unsharded) at the flagship's 1536 width (depth
+        # truncated for CPU wall-clock) — width is what the tok/s gate
+        # exercises: per-layer matmul work grows quadratically with it
+        # while the all-reduce bytes grow linearly, so the mp overhead
+        # amortizes the same way it does on real chips
+        mp = _parse_mesh_degrees(os.environ.get("PTPU_MESH", "mp2")
+                                 ).get("mp", 2)
+        vcfg = GPTConfig(vocab_size=50304, hidden_size=1536,
+                         num_layers=6, num_heads=16, max_seq_len=256,
+                         dtype="float32", use_flash_attention=False)
+        leg = _run_servemp_leg(vcfg, mp, n_requests=6, max_new=24,
+                               max_slots=6, block_size=16,
+                               prefill_chunk=64)
+        print(json.dumps({
+            "metric": "gpt760m_servemp_decode_tokens_per_sec_per_chip",
+            "value": leg["decode_tokens_per_sec_per_chip"],
+            "unit": "tokens/s/chip",
+            "vs_baseline": leg["per_chip_hbm_frac"],  # KV+W vs 1 chip
+            "tps_frac_vs_unsharded": leg["tps_frac_vs_unsharded"],
+            "legs": {"gpt760m_servemp": leg},
+        }))
+        return
+
     if not on_tpu:  # CPU fallback so the bench always produces a line
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=128,
@@ -1671,11 +1822,11 @@ def main():
     which = os.environ.get("PTPU_BENCH", "all")
     if which not in ("all", "760m", "125m", "serve", "paged", "paged_q",
                      "tiered", "spec", "ckpt", "fleet", "disagg", "mesh",
-                     "mesh760m"):
+                     "mesh760m", "servemp"):
         raise SystemExit(
             f"PTPU_BENCH={which!r}: expected "
             f"all|760m|125m|serve|paged|paged_q|tiered|spec|ckpt|fleet|"
-            f"disagg|mesh|mesh760m")
+            f"disagg|mesh|mesh760m|servemp")
     mesh_degrees = _parse_mesh_degrees(os.environ.get("PTPU_MESH", "dp2"))
     mesh_ndev = int(np.prod(list(mesh_degrees.values())))
     legs = {}
@@ -1826,6 +1977,22 @@ def main():
                                              mesh_degrees,
                                              fused_steps=max(1, fused_k),
                                              peak=peak, min_scaling=0.70)
+    if which == "servemp":
+        # tensor-parallel serving leg: mp-way mesh paged engine vs the
+        # unsharded engine at EQUAL admitted capacity (acceptance: token
+        # identity, zero steady retraces, per-chip KV+weight HBM <= 0.6x
+        # single-chip, decode tok/s >= 0.9x unsharded). Runs the 760m
+        # flagship — only reachable on TPU; off-TPU the CPU-fallback
+        # twin earlier in main() handles PTPU_BENCH=servemp.
+        mp = _parse_mesh_degrees(os.environ.get("PTPU_MESH", "mp2")
+                                 ).get("mp", 2)
+        vcfg = GPTConfig.gpt3_760m(vocab_size=50304, max_seq_len=1024,
+                                   dtype="bfloat16",
+                                   use_flash_attention=False,
+                                   recompute=None)
+        legs["gpt760m_servemp"] = _run_servemp_leg(
+            vcfg, mp, n_requests=16, max_new=64, max_slots=8,
+            block_size=16, prefill_chunk=256)
     if which == "mesh760m":
         mcfg = GPTConfig.gpt3_760m(vocab_size=50304, max_seq_len=1024,
                                    dtype="bfloat16",
@@ -1846,6 +2013,17 @@ def main():
             "unit": "tokens/s/chip",
             "vs_baseline": leg["mfu"],  # true MFU fraction (bf16 peak)
             "scaling_efficiency": leg["scaling_efficiency"],
+            "legs": legs,
+        }))
+        return
+    if set(legs) == {"gpt760m_servemp"}:  # servemp-only: per-chip line
+        leg = legs["gpt760m_servemp"]
+        print(json.dumps({
+            "metric": "gpt760m_servemp_decode_tokens_per_sec_per_chip",
+            "value": leg["decode_tokens_per_sec_per_chip"],
+            "unit": "tokens/s/chip",
+            "vs_baseline": leg["per_chip_hbm_frac"],  # KV+W vs 1 chip
+            "tps_frac_vs_unsharded": leg["tps_frac_vs_unsharded"],
             "legs": legs,
         }))
         return

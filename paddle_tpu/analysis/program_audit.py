@@ -22,7 +22,10 @@ traces and lowers it ahead-of-time and verifies:
   * **collective census** — for single-device programs, statically prove
     zero collective primitives (the jaxpr-level analogue of the
     ``dist.collective_launches == 0`` counter gate); for mesh programs,
-    report count/kind.
+    ``expected_collectives=`` names the allowlisted in-graph kinds and the
+    auditor censuses the **compiled HLO** (where GSPMD actually inserts
+    them) — allowlisted kinds tick ``analysis.collectives_in_graph``,
+    anything else is a finding.
   * **HBM budget** — ``memory_analysis()`` argument + output + temp bytes
     against a declared budget.
 
@@ -70,6 +73,14 @@ COLLECTIVE_PRIMITIVES = frozenset({
     "psum", "psum2", "pmax", "pmin", "pmean", "ppermute", "pbroadcast",
     "all_gather", "all_to_all", "reduce_scatter", "psum_scatter",
     "pgather",
+})
+
+# HLO op names GSPMD may insert for sharded programs (the compiled-module
+# census ``expected_collectives=`` checks against; async '-start' forms
+# are folded into their base kind).
+HLO_COLLECTIVE_KINDS = frozenset({
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all", "collective-broadcast",
 })
 
 _DONATION_WARNING_RE = re.compile(r"donated buffers were not usable",
@@ -221,6 +232,7 @@ def _leaf_paths(tree) -> list:
 def audit_program(name, jit_fn, *args,
                   donate_argnums=(),
                   expect_no_collectives=False,
+                  expected_collectives=None,
                   hbm_budget_bytes=None,
                   compile_program=True,
                   **kwargs) -> AuditReport:
@@ -229,8 +241,12 @@ def audit_program(name, jit_fn, *args,
     ``jit_fn`` must be the already-``jax.jit``-wrapped callable (so the
     audit sees exactly the donation/static-argnum config the hot path
     uses); ``args``/``kwargs`` are example inputs of the real shapes.
-    Returns an :class:`AuditReport`; never raises on findings (callers —
-    see :func:`maybe_audit` — decide whether to enforce).
+    ``expected_collectives`` (an iterable of HLO op names, e.g.
+    ``{"all-reduce"}``) marks a mesh program whose compiled module may
+    contain exactly those in-graph collective kinds — any other kind is
+    a ``collective-budget`` finding.  Returns an :class:`AuditReport`;
+    never raises on findings (callers — see :func:`maybe_audit` — decide
+    whether to enforce).
     """
     import jax
 
@@ -263,7 +279,8 @@ def audit_program(name, jit_fn, *args,
                    primitive=prim, count=prim_counts[prim])
     report.collective_counts = {
         p: c for p, c in prim_counts.items() if p in COLLECTIVE_PRIMITIVES}
-    if expect_no_collectives and report.collective_counts:
+    if (expect_no_collectives and expected_collectives is None
+            and report.collective_counts):
         kinds = ", ".join(f"{p} x{c}"
                           for p, c in sorted(report.collective_counts.items()))
         report.add("collective-budget",
@@ -350,6 +367,37 @@ def audit_program(name, jit_fn, *args,
                    f"XLA dropped donated buffers: {dropped_msgs[0]}",
                    xla_warnings=dropped_msgs[:4])
 
+    # --- compiled-HLO collective census (mesh programs).  GSPMD inserts
+    # the TP collectives at XLA compile time, so they never appear in the
+    # jaxpr census above — scan the compiled module text instead.  Kinds
+    # on the allowlist tick analysis.collectives_in_graph (the
+    # in-graph-collectives-only proof check_counters asserts on); any
+    # other collective kind is a finding.
+    compiled = None
+    if expected_collectives is not None and compile_program:
+        allowed = frozenset(expected_collectives)
+        try:
+            compiled = lowered.compile()
+            hlo = compiled.as_text()
+        except Exception as e:
+            report.notes.append(f"HLO collective census unavailable: {e!r}")
+            hlo = ""
+        census = {}
+        for kind in sorted(HLO_COLLECTIVE_KINDS):
+            n = len(re.findall(rf"\b{re.escape(kind)}(?:-start)?\(", hlo))
+            if n:
+                census[kind] = n
+        report.collective_counts = dict(report.collective_counts, **census)
+        good = sum(c for k, c in census.items() if k in allowed)
+        if good:
+            _counters.inc("analysis.collectives_in_graph", good)
+        bad = {k: c for k, c in census.items() if k not in allowed}
+        if bad:
+            kinds = ", ".join(f"{k} x{c}" for k, c in sorted(bad.items()))
+            report.add("collective-budget",
+                       f"mesh program contains disallowed collective "
+                       f"kinds: {kinds}", collectives=bad)
+
     # --- compile + memory budget.  The compile is only needed to feed
     # memory_analysis(), so skip it entirely when no budget is declared —
     # the audit stays trace+lower-only and adds no second XLA compile to
@@ -359,7 +407,7 @@ def audit_program(name, jit_fn, *args,
         hbm_budget_bytes = int(budget_mb * 1024 * 1024) or None
     if compile_program and hbm_budget_bytes and not report.findings:
         try:
-            compiled = lowered.compile()
+            compiled = compiled or lowered.compile()
             mem = compiled.memory_analysis()
             if mem is not None:
                 report.memory = {

@@ -79,7 +79,8 @@ def _mesh_axis_size(mesh, axes):
     return size
 
 
-def validate_spec(spec, shape, mesh, name="<leaf>", quiet=False):
+def validate_spec(spec, shape, mesh, name="<leaf>", quiet=False,
+                  on_fallback=None):
     """Check a PartitionSpec against an array shape and a mesh.
 
     Returns the spec unchanged when every named axis exists on the mesh and
@@ -87,12 +88,16 @@ def validate_spec(spec, shape, mesh, name="<leaf>", quiet=False):
     otherwise warns (unless ``quiet``) and returns the replicated spec
     ``P()``.  Keeping this a soft fallback (rather than an error) lets one
     rule set serve several mesh shapes — an axis of size 1 still validates
-    and shards trivially.
+    and shards trivially.  ``on_fallback`` (if given) is called with the
+    degradation message so callers can count degraded leaves (the serving
+    arena ticks ``serving.mesh.spec_degraded``).
     """
     def _fallback(msg):
         if not quiet:
             warnings.warn("infer_partition_specs: " + msg, RuntimeWarning,
                           stacklevel=4)
+        if on_fallback is not None:
+            on_fallback(msg)
         return P()
 
     if spec is None:
@@ -134,7 +139,8 @@ def _path_str(path):
     return "/".join(parts)
 
 
-def infer_partition_specs(pytree, mesh, rules, default=P()):
+def infer_partition_specs(pytree, mesh, rules, default=P(),
+                          on_fallback=None):
     """Map every array leaf of ``pytree`` to a PartitionSpec via regex rules.
 
     ``rules`` is an ordered sequence of ``(pattern, PartitionSpec)`` pairs;
@@ -157,7 +163,8 @@ def infer_partition_specs(pytree, mesh, rules, default=P()):
         pstr = _path_str(path)
         for pat, spec in compiled:
             if pat.search(pstr):
-                return validate_spec(spec, shape, mesh, name=pstr)
+                return validate_spec(spec, shape, mesh, name=pstr,
+                                     on_fallback=on_fallback)
         return default
 
     return jax.tree_util.tree_map_with_path(leaf_spec, pytree)

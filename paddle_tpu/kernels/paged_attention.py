@@ -40,6 +40,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ..core.flags import define_flag, flag
 from ..profiler import counters
@@ -251,6 +252,38 @@ def paged_decode_attention(q, pool_k, pool_v, bt, pos, scale_k=None,
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_INTERPRET[0],
     )(bt, pos, *args)
+
+
+def sharded_paged_decode_attention(mesh, axis, q, pool_k, pool_v, bt, pos,
+                                   scale_k=None, scale_v=None, *, scale):
+    """Head-sharded twin of :func:`paged_decode_attention`.
+
+    The kernel's per-head matmuls are fully independent, so a pool whose
+    head axis is sharded over ``axis`` (``[n_blocks, bs, nh/mp, hd]`` per
+    chip) decodes with one ``shard_map`` over the heads: each chip runs
+    the unmodified kernel on its head slice against the replicated block
+    tables/positions/scales, and the concatenated ``[B, nh, hd]`` output
+    needs no collective at all — the TP all-reduce happens later, at the
+    projection contraction GSPMD partitions.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    hspec = P(None, axis, None)                 # q / output: heads on dim 1
+    pspec = P(None, None, axis, None)           # pools: heads on dim 2
+    in_specs = [hspec, pspec, pspec, P(), P()]
+    args = [q, pool_k, pool_v, bt, pos]
+    if scale_k is not None:
+        in_specs += [P(), P()]                  # per-token scales replicate
+        args += [scale_k, scale_v]
+
+    def _local(q_, pk_, pv_, bt_, pos_, *scales):
+        sk_, sv_ = scales if scales else (None, None)
+        return paged_decode_attention(q_, pk_, pv_, bt_, pos_, sk_, sv_,
+                                      scale=scale)
+
+    fn = shard_map(_local, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=hspec, check_rep=False)
+    return fn(*args)
 
 
 def note_program(backend):

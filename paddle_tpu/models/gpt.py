@@ -771,7 +771,8 @@ class GPTForCausalLM(Layer):
         return pool_k, pool_v, logits
 
     def decode_paged(self, w, tok, pos, bt, pool_k, pool_v,
-                     scale_k=None, scale_v=None, kernel=None):
+                     scale_k=None, scale_v=None, kernel=None,
+                     mesh=None, head_axis=None):
         """One decode step for B slot rows over the block-pool arena (the
         paged twin of ``decode_slots`` — identical math, the arena row is
         replaced by a block-table gather).
@@ -789,7 +790,12 @@ class GPTForCausalLM(Layer):
         block-table walk (``kernels.paged_attention``) instead of the
         gather einsum — same operands, same mask, no ``[B, S]`` logical
         view in HBM.  ``kernel=None``/``"off"`` keeps the plain-XLA
-        gather below as the reference twin.  Quantized-KV mode mirrors
+        gather below as the reference twin.  Under tensor parallelism
+        pass ``mesh``/``head_axis`` (the serving arena does): the pallas
+        call then runs through ``shard_map`` over the KV head axis —
+        each chip walks only its own ``nh/mp`` heads, and the cross-chip
+        reduction happens at the following proj contraction exactly as
+        in the gather twin (GSPMD partitions that twin with no help).  Quantized-KV mode mirrors
         ``prefill_paged``: per-token fp32 scale arenas ``scale_k``/
         ``scale_v [L, n_blocks, bs]`` ride the donated carry, the new
         token quantizes on insert, and the return grows to ``(logits,
@@ -847,8 +853,14 @@ class GPTForCausalLM(Layer):
             if mode == "pallas":
                 # fused block-table walk: the arena is read in physical
                 # blocks, never gathered to [B, S]
-                o = _pa.paged_decode_attention(
-                    q[:, 0] * scale, ck, cv, bt, pos, sk, sv, scale=1.0)
+                if mesh is not None and head_axis is not None:
+                    o = _pa.sharded_paged_decode_attention(
+                        mesh, head_axis, q[:, 0] * scale, ck, cv, bt,
+                        pos, sk, sv, scale=1.0)
+                else:
+                    o = _pa.paged_decode_attention(
+                        q[:, 0] * scale, ck, cv, bt, pos, sk, sv,
+                        scale=1.0)
                 o = o.reshape(B, 1, H)
             else:
                 if quant:

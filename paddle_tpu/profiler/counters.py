@@ -107,6 +107,15 @@ Well-known names (see README "Observability" for the full table):
   serving.spec.acceptance / serving.spec.yield (gauges: acceptance-rate
       EMA and emitted-tokens-per-round-per-slot EMA)
   serving.fleet.spec_acceptance (gauge: drafted-weighted fleet mean)
+  serving.mesh.spec_degraded (sharding specs soft-degraded to
+      replicated by the StateArena — e.g. nh not divisible by mp; 0
+      when every declared leaf sharded as ruled)
+  serving.arena.program_hits / serving.arena.program_misses (StateArena
+      compile-cache outcomes; misses only at warmup, 0 in steady state)
+  serving.arena.program_evictions (programs dropped by the arena LRU
+      cap) / serving.arena.program_rebuilds (evicted keys compiled
+      AGAIN — the retrace-accounting signal; MUST be 0 in steady state)
+  serving.arena.programs (gauge: live programs the arena fronts)
   kernels.paged.pallas_programs / kernels.paged.xla_fallbacks
       (trace-time: paged decode programs compiled with the fused Pallas
       backend vs the plain-XLA gather twin; 0 in steady state)
@@ -137,6 +146,10 @@ Well-known names (see README "Observability" for the full table):
   analysis.findings / analysis.findings.<rule> (audit invariant
       violations: donation-dropped / host-callback / dynamic-shape /
       f64-promotion / collective-budget / hbm-budget / trace-error)
+  analysis.collectives_in_graph (allowlisted collective ops found in
+      audited mesh programs' compiled HLO — the in-graph-collectives-
+      only proof: > 0 with dist.collective_launches == 0 means every
+      cross-chip reduction is GSPMD-inserted, none host-launched)
   health.ticks (HealthMonitor snapshot ticks; 0 when FLAGS_health off —
       the zero-overhead-off gate of the health plane)
   health.alerts.fired / health.alerts.fired.<rule> (0->1 alert

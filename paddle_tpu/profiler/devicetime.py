@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import re as _re
 import tempfile
 import threading
 import time
@@ -203,6 +204,14 @@ def _efficiency(name, mean_s):
     if not mean_s or mean_s <= 0:
         return out
     stats = _metrics.program_stats(name)
+    if not stats:
+        # mesh-decorated ledger keys (e.g. "serving.decode_paged[mp2]")
+        # fall back to the base program's AOT stats — the per-chip FLOPs
+        # differ but the roofline classification and MFU trend survive,
+        # and the sharded row stops silently dropping from the report
+        base = _re.sub(r"\[(?:[a-z]{2,}\d+)+\]", "", name)
+        if base != name:
+            stats = _metrics.program_stats(base)
     flops = stats.get("flops")
     hbm = 0
     for k in ("arg_bytes", "out_bytes"):

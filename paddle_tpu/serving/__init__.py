@@ -8,11 +8,17 @@ an SLO-aware ``Router`` with heartbeat health-checking and fault-driven
 drain/respawn.  A paged fleet can run disaggregated — prefill replicas
 hand finished prompts to decode replicas by block-granular KV migration,
 with ``FleetAutoscaler`` rebalancing the split from health-plane burn
-alerts.  See ``serving.engine`` / ``serving.fleet`` for the design notes
-and README "Serving" / "Elastic serving" / "Disaggregated serving" for
-the API tour.
+alerts.  Engines can run tensor-parallel over a JAX mesh
+(``LLMEngine(mesh=...)``): the ``StateArena`` spec layer shards the KV
+block pools' head axis and the weight matrices across chips while the
+compiled programs stay single (GSPMD inserts in-graph collectives).  See
+``serving.engine`` / ``serving.fleet`` / ``serving.arena`` for the
+design notes and README "Serving" / "Elastic serving" / "Disaggregated
+serving" / "Sharded serving" for the API tour.
 """
 
+from .arena import (DEFAULT_SHARD_RULES, KV_POOL_SPEC,  # noqa: F401
+                    StateArena)
 from .autoscale import FleetAutoscaler  # noqa: F401
 from .engine import (EngineBackpressure, EngineClosed, LLMEngine,  # noqa: F401
                      Request, bucket_length)
@@ -29,4 +35,5 @@ __all__ = ["LLMEngine", "PagedLLMEngine", "SpeculativeLLMEngine", "Request",
            "filter_logits", "sample_tokens", "residual_sample",
            "ServingFleet", "FleetRequest", "Replica", "FleetAutoscaler",
            "Router", "RetryAfter", "BlockPool", "BlockPoolExhausted",
-           "PrefixCache", "blocks_for_tokens"]
+           "PrefixCache", "blocks_for_tokens", "StateArena",
+           "DEFAULT_SHARD_RULES", "KV_POOL_SPEC"]

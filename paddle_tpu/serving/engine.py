@@ -49,6 +49,7 @@ from ..profiler import flight
 from ..profiler import metrics
 from ..profiler import trace as rtrace
 from ..profiler.host_tracer import span
+from .arena import StateArena
 from .sampling import filter_logits
 
 # the arena/chunk donations are a no-op on CPU backends; the warning would
@@ -207,7 +208,8 @@ class LLMEngine:
                  min_bucket=8, eos_token_id=None, kv_layout="slots",
                  block_size=16, n_blocks=None, prefill_chunk=None,
                  prefix_cache=True, kv_dtype=None, weight_dtype=None,
-                 host_kv_blocks=0, spill_idle_steps=0):
+                 host_kv_blocks=0, spill_idle_steps=0, mesh=None,
+                 shard_rules=None):
         if kv_layout not in ("slots", "paged"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}; "
                              "want 'slots' or 'paged'")
@@ -244,11 +246,17 @@ class LLMEngine:
         self.queue_size = int(queue_size)
         self.min_bucket = int(min_bucket)
         self.eos_token_id = eos_token_id  # default for requests
+        # the arena owns every declared device-resident leaf (weights, KV
+        # pools, scale pools) with resolved NamedSharding specs; with
+        # mesh=None it is a bit-identical pass-through
+        self.arena = StateArena(mesh=mesh, shard_rules=shard_rules)
         if weight_dtype == "int8":
             from ..quantization import ptq_int8_decode_state
-            self._w = ptq_int8_decode_state(model)
+            self._w = self.arena.declare_tree(
+                "weights", ptq_int8_decode_state(model))
         else:
-            self._w = model.decode_state()
+            self._w = self.arena.declare_tree(
+                "weights", model.decode_state())
 
         B, S = self.max_slots, self.max_seq_len
         nh = c.num_heads
@@ -317,8 +325,17 @@ class LLMEngine:
         ``serving.retraces`` once per program, at the compile/warmup site
         only — steady-state windows see a no-op set lookup."""
         from ..analysis import program_audit as _audit
-        _audit.maybe_audit(name, fn, *args, donate_argnums=donate_argnums,
-                           expect_no_collectives=True)
+        expected = self.arena.expected_collectives
+        if expected is not None:
+            # multi-device arena: in-graph collectives (GSPMD's TP
+            # reductions) are expected; anything else still fails
+            _audit.maybe_audit(name, fn, *args,
+                               donate_argnums=donate_argnums,
+                               expected_collectives=expected)
+        else:
+            _audit.maybe_audit(name, fn, *args,
+                               donate_argnums=donate_argnums,
+                               expect_no_collectives=True)
 
     def histogram_snapshot(self):
         """Copies of the per-engine histograms (point-in-time, safe to
@@ -327,9 +344,34 @@ class LLMEngine:
 
     def _init_kv(self, c, B, S, nh, hd, dt):
         """Allocate the device KV storage: the slot arena here, a block
-        pool in the PagedLLMEngine override."""
-        self._ck = jnp.zeros((c.num_layers, B, S, nh, hd), dt)
-        self._cv = jnp.zeros((c.num_layers, B, S, nh, hd), dt)
+        pool in the PagedLLMEngine override.  Declared through the
+        StateArena so the head axis shards over ``mp`` when a mesh is
+        set (``[L, B, S, nh/mp, hd]``)."""
+        from .arena import KV_POOL_SPEC
+        self.arena.declare("slot_k",
+                           jnp.zeros((c.num_layers, B, S, nh, hd), dt),
+                           spec=KV_POOL_SPEC)
+        self.arena.declare("slot_v",
+                           jnp.zeros((c.num_layers, B, S, nh, hd), dt),
+                           spec=KV_POOL_SPEC)
+
+    # the slot arena lives in the StateArena; donated-program outputs are
+    # rebound through the setters so every rebind site inherits the spec
+    @property
+    def _ck(self):
+        return self.arena.get("slot_k")
+
+    @_ck.setter
+    def _ck(self, v):
+        self.arena.bind("slot_k", v)
+
+    @property
+    def _cv(self):
+        return self.arena.get("slot_v")
+
+    @_cv.setter
+    def _cv(self, v):
+        self.arena.bind("slot_v", v)
 
     def release_kv(self):
         """Drop the device KV storage (a dead replica's arena is garbage
@@ -365,11 +407,9 @@ class LLMEngine:
     def _prefill_for(self, bucket):
         fn = self._prefill_jits.get(bucket)
         if fn is None:
-            progs = _model_programs(self.model)
-            fn = progs.get("prefill_slot")
-            if fn is None:
-                model = self.model
+            model = self.model
 
+            def build():
                 def prefill(w, ids, length, key_data, do_sample, temp,
                             top_k, top_p):
                     counters.inc("serving.retraces")  # trace-time only
@@ -378,7 +418,10 @@ class LLMEngine:
                         logits, jax.random.wrap_key_data(key_data),
                         do_sample, temp, top_k, top_p)
                     return ck, cv, tok, new_key
-                fn = progs["prefill_slot"] = jax.jit(prefill)
+                return jax.jit(prefill)
+            fn = self.arena.program(_model_programs(model),
+                                    self.arena.decorate("prefill_slot"),
+                                    build)
             self._prefill_jits[bucket] = fn
             counters.set_gauge("serving.prefill_programs",
                                len(self._prefill_jits))
@@ -387,14 +430,13 @@ class LLMEngine:
     def _insert_for(self, bucket):
         fn = self._insert_jits.get(bucket)
         if fn is None:
-            progs = _model_programs(self.model)
             L = self.config.num_layers
             nh = self.config.num_heads
             hd = self.config.hidden_size // nh
             S = self.max_seq_len
-            key = ("insert_slot", S)
-            fn = progs.get(key)
-            if fn is None:
+            key = (self.arena.decorate("insert_slot"), S)
+
+            def build():
                 def insert(ck, cv, kc, vc, slot):
                     counters.inc("serving.retraces")
                     zk = jnp.zeros((L, 1, S, nh, hd), kc.dtype)
@@ -408,17 +450,16 @@ class LLMEngine:
                     cv = jax.lax.dynamic_update_slice(cv, zv,
                                                       (0, slot, 0, 0, 0))
                     return ck, cv
-                fn = progs[key] = jax.jit(insert, donate_argnums=(0, 1))
+                return jax.jit(insert, donate_argnums=(0, 1))
+            fn = self.arena.program(_model_programs(self.model), key, build)
             self._insert_jits[bucket] = fn
         return fn
 
     def _decode(self):
         if self._decode_jit is None:
-            progs = _model_programs(self.model)
-            fn = progs.get("decode_slots")
-            if fn is None:
-                model = self.model
+            model = self.model
 
+            def build():
                 def decode(w, ck, cv, tok, pos, keys_data, do_sample, temp,
                            top_k, top_p):
                     counters.inc("serving.retraces")
@@ -437,9 +478,10 @@ class LLMEngine:
                     nxt = jnp.where(do_sample, sampled,
                                     greedy).astype(jnp.int32)
                     return nxt, ck, cv, jax.random.key_data(new_keys)
-                fn = progs["decode_slots"] = jax.jit(
-                    decode, donate_argnums=(1, 2))
-            self._decode_jit = fn
+                return jax.jit(decode, donate_argnums=(1, 2))
+            self._decode_jit = self.arena.program(
+                _model_programs(model),
+                self.arena.decorate("decode_slots"), build)
         return self._decode_jit
 
     # -- request intake ------------------------------------------------------
@@ -657,25 +699,25 @@ class LLMEngine:
                     jax.random.key_data(jax.random.key(req.seed)))
                 with span("serving.prefill"):
                     pf = self._prefill_for(bucket)
-                    pargs = (self._w, jnp.asarray(ids), np.int32(T),
+                    pname = self.arena.decorate(f"serving.prefill[b{bucket}]")
+                    iname = self.arena.decorate(f"serving.insert[b{bucket}]")
+                    pargs = (self._w, self.arena.operand(ids), np.int32(T),
                              key_data, np.bool_(req.do_sample),
                              np.float32(req.temperature),
                              np.int32(req.top_k), np.float32(req.top_p))
-                    self._maybe_capture(f"serving.prefill[b{bucket}]",
-                                        pf, *pargs)
-                    self._maybe_audit(f"serving.prefill[b{bucket}]",
-                                      pf, *pargs)
-                    _dt = _devicetime.note(f"serving.prefill[b{bucket}]")
+                    self._maybe_capture(pname, pf, *pargs)
+                    self._maybe_audit(pname, pf, *pargs)
+                    _dt = _devicetime.note(pname)
                     kc, vc, tok, new_key = pf(*pargs)
                     _devicetime.observe(_dt, (kc, vc, tok))
                     ins = self._insert_for(bucket)
-                    self._maybe_capture(f"serving.insert[b{bucket}]", ins,
+                    self._maybe_capture(iname, ins,
                                         self._ck, self._cv, kc, vc,
                                         np.int32(slot))
-                    self._maybe_audit(f"serving.insert[b{bucket}]", ins,
+                    self._maybe_audit(iname, ins,
                                       self._ck, self._cv, kc, vc,
                                       np.int32(slot), donate_argnums=(0, 1))
-                    _dt = _devicetime.note(f"serving.insert[b{bucket}]")
+                    _dt = _devicetime.note(iname)
                     self._ck, self._cv = ins(
                         self._ck, self._cv, kc, vc, np.int32(slot))
                     _devicetime.observe(_dt, (self._ck, self._cv))
@@ -716,15 +758,17 @@ class LLMEngine:
         t0_tr = time.perf_counter_ns() if tr_on else 0
         with span("serving.decode"):
             dec = self._decode()
+            op = self.arena.operand
+            dname = self.arena.decorate("serving.decode")
             dargs = (self._w, self._ck, self._cv,
-                     jnp.asarray(self._tok), jnp.asarray(self._pos),
-                     jnp.asarray(self._keys), jnp.asarray(self._dosample),
-                     jnp.asarray(self._temp), jnp.asarray(self._topk),
-                     jnp.asarray(self._topp))
-            self._maybe_capture("serving.decode", dec, *dargs)
-            self._maybe_audit("serving.decode", dec, *dargs,
+                     op(self._tok), op(self._pos),
+                     op(self._keys), op(self._dosample),
+                     op(self._temp), op(self._topk),
+                     op(self._topp))
+            self._maybe_capture(dname, dec, *dargs)
+            self._maybe_audit(dname, dec, *dargs,
                               donate_argnums=(1, 2))
-            _dt = _devicetime.note("serving.decode")
+            _dt = _devicetime.note(dname)
             nxt, self._ck, self._cv, new_keys = dec(*dargs)
             _devicetime.observe(_dt, nxt)
             nxt = np.asarray(nxt)

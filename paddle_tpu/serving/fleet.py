@@ -270,7 +270,7 @@ class ServingFleet:
                  weight_dtype=None, draft_model=None, spec_k=4,
                  prefill_replicas=0, autoscale=False, autoscale_kw=None,
                  health_kw=None, host_kv_blocks=0, spill_idle_steps=0,
-                 restore_cost=0.5):
+                 restore_cost=0.5, mesh=None, shard_rules=None):
         self.model = model
         prefill_replicas = int(prefill_replicas)
         if prefill_replicas:
@@ -294,6 +294,12 @@ class ServingFleet:
                                weight_dtype=weight_dtype,
                                host_kv_blocks=host_kv_blocks,
                                spill_idle_steps=spill_idle_steps)
+        if mesh is not None:
+            # every replica constructs a mesh-backed engine: each gets
+            # its own StateArena over the SAME mesh, so replicas shard
+            # their pools/weights identically and still share the tagged
+            # compiled programs through the per-model registry
+            self._engine_kw.update(mesh=mesh, shard_rules=shard_rules)
         if draft_model is not None:
             # every replica runs draft/verify speculative decoding; the
             # compiled draft + verify programs are shared fleet-wide

@@ -113,15 +113,25 @@ class PagedLLMEngine(LLMEngine):
         # different flag values can never silently share a program
         self.kv_kernel = _pa.kernel_mode()
         adt = _pa.KV_DTYPES[self.kv_dtype] if self.kv_dtype else dt
-        self._pk = jnp.zeros((c.num_layers, self.n_blocks, bs, nh, hd), adt)
-        self._pv = jnp.zeros((c.num_layers, self.n_blocks, bs, nh, hd), adt)
+        from .arena import KV_POOL_SPEC
+        self.arena.declare(
+            "pool_k",
+            jnp.zeros((c.num_layers, self.n_blocks, bs, nh, hd), adt),
+            spec=KV_POOL_SPEC)
+        self.arena.declare(
+            "pool_v",
+            jnp.zeros((c.num_layers, self.n_blocks, bs, nh, hd), adt),
+            spec=KV_POOL_SPEC)
         if self.kv_dtype:
             # per-token fp32 scales at the same (layer, block, position)
-            # address as the quantized tiles (donated alongside them)
-            self._sk = jnp.zeros((c.num_layers, self.n_blocks, bs),
-                                 jnp.float32)
-            self._sv = jnp.zeros((c.num_layers, self.n_blocks, bs),
-                                 jnp.float32)
+            # address as the quantized tiles (donated alongside them);
+            # no head axis, so they stay replicated on a mesh
+            self.arena.declare(
+                "scale_k",
+                jnp.zeros((c.num_layers, self.n_blocks, bs), jnp.float32))
+            self.arena.declare(
+                "scale_v",
+                jnp.zeros((c.num_layers, self.n_blocks, bs), jnp.float32))
             tile = c.num_layers * self.n_blocks * bs * nh * hd
             raw = 2 * tile * jnp.dtype(dt).itemsize
             quant = (2 * tile * jnp.dtype(adt).itemsize
@@ -130,7 +140,8 @@ class PagedLLMEngine(LLMEngine):
             counters.set_gauge("serving.kv.quant.bytes_saved",
                                max(raw - quant, 0))
         else:
-            self._sk = self._sv = None
+            self.arena.declare("scale_k", None)
+            self.arena.declare("scale_v", None)
         # per-slot block tables (host mirror; rides decode as an operand)
         self._bt = np.zeros((B, self.max_blocks), np.int32)
         self._running = np.zeros(B, np.bool_)
@@ -168,6 +179,43 @@ class PagedLLMEngine(LLMEngine):
         self.kv_tier_spilled = 0
         self.kv_tier_restored = 0
 
+    # the block pools (+ scale pools) live in the StateArena; the
+    # donated-program outputs rebind through the setters, so every
+    # dispatch site — chunk prefill, decode, COW, migration,
+    # spill/restore — inherits the resolved sharding without re-proving
+    # donation safety
+    @property
+    def _pk(self):
+        return self.arena.get("pool_k")
+
+    @_pk.setter
+    def _pk(self, v):
+        self.arena.bind("pool_k", v)
+
+    @property
+    def _pv(self):
+        return self.arena.get("pool_v")
+
+    @_pv.setter
+    def _pv(self, v):
+        self.arena.bind("pool_v", v)
+
+    @property
+    def _sk(self):
+        return self.arena.get("scale_k")
+
+    @_sk.setter
+    def _sk(self, v):
+        self.arena.bind("scale_k", v)
+
+    @property
+    def _sv(self):
+        return self.arena.get("scale_v")
+
+    @_sv.setter
+    def _sv(self, v):
+        self.arena.bind("scale_v", v)
+
     def release_kv(self):
         self._pk = self._pv = self._sk = self._sv = None
 
@@ -203,19 +251,21 @@ class PagedLLMEngine(LLMEngine):
     # Engines whose attention backend or KV precision differ get distinct
     # cache keys (``_prog_key``) — a program traced under one
     # FLAGS_paged_kernel / kv_dtype must never serve another.
+    # The arena tag (e.g. "[mp2]") rides the key AND the display name so
+    # a sharded program can never serve an unsharded engine, and ledger /
+    # capture rows stay distinguishable per mesh shape.
     def _prog_key(self, base):
         if self.kv_kernel == "off" and self.kv_dtype is None:
-            return base
-        return f"{base}@{self.kv_kernel}:{self.kv_dtype or 'raw'}"
+            return base + self.arena.tag
+        return (f"{base}@{self.kv_kernel}:{self.kv_dtype or 'raw'}"
+                f"{self.arena.tag}")
 
     def _pchunk_for(self, bucket):
         fn = self._pchunk_jits.get(bucket)
         if fn is None:
-            progs = _model_programs(self.model)
-            key = self._prog_key("prefill_paged")
-            fn = progs.get(key)
-            if fn is None:
-                model = self.model
+            model = self.model
+
+            def build():
                 if self.kv_dtype:
                     def pchunk(w, ids, start, length, bt, pk, pv, sk, sv,
                                key_data, do_sample, temp, top_k, top_p):
@@ -226,19 +276,20 @@ class PagedLLMEngine(LLMEngine):
                             logits, jax.random.wrap_key_data(key_data),
                             do_sample, temp, top_k, top_p)
                         return pk, pv, sk, sv, tok, new_key
-                    fn = jax.jit(pchunk, donate_argnums=(5, 6, 7, 8))
-                else:
-                    def pchunk(w, ids, start, length, bt, pk, pv, key_data,
-                               do_sample, temp, top_k, top_p):
-                        counters.inc("serving.retraces")  # trace-time only
-                        pk, pv, logits = model.prefill_paged(
-                            w, ids, start, length, bt, pk, pv)
-                        tok, new_key = LLMEngine._first_token(
-                            logits, jax.random.wrap_key_data(key_data),
-                            do_sample, temp, top_k, top_p)
-                        return pk, pv, tok, new_key
-                    fn = jax.jit(pchunk, donate_argnums=(5, 6))
-                progs[key] = fn
+                    return jax.jit(pchunk, donate_argnums=(5, 6, 7, 8))
+
+                def pchunk(w, ids, start, length, bt, pk, pv, key_data,
+                           do_sample, temp, top_k, top_p):
+                    counters.inc("serving.retraces")  # trace-time only
+                    pk, pv, logits = model.prefill_paged(
+                        w, ids, start, length, bt, pk, pv)
+                    tok, new_key = LLMEngine._first_token(
+                        logits, jax.random.wrap_key_data(key_data),
+                        do_sample, temp, top_k, top_p)
+                    return pk, pv, tok, new_key
+                return jax.jit(pchunk, donate_argnums=(5, 6))
+            fn = self.arena.program(_model_programs(model),
+                                    self._prog_key("prefill_paged"), build)
             self._pchunk_jits[bucket] = fn
             counters.set_gauge("serving.prefill_programs",
                                len(self._pchunk_jits))
@@ -246,13 +297,19 @@ class PagedLLMEngine(LLMEngine):
 
     def _pdecode(self):
         if self._pdecode_jit is None:
-            progs = _model_programs(self.model)
-            key = self._prog_key("decode_paged")
-            fn = progs.get(key)
-            if fn is None:
-                model = self.model
-                mode = self.kv_kernel
+            model = self.model
+            mode = self.kv_kernel
+            # the pallas kernel is per-head independent, so under a mesh
+            # whose KV head axis actually sharded it runs through a
+            # shard_map over "mp" (see kernels.paged_attention); the
+            # gather twin needs nothing — GSPMD partitions it from the
+            # committed input shardings alone
+            mesh = (self.arena.mesh
+                    if mode == "pallas" and self.arena.kv_head_axis
+                    else None)
+            head_axis = "mp" if mesh is not None else None
 
+            def build():
                 def sample_next(logits, keys_data, do_sample, temp, top_k,
                                 top_p):
                     keys = jax.random.wrap_key_data(keys_data)
@@ -274,25 +331,28 @@ class PagedLLMEngine(LLMEngine):
                                do_sample, temp, top_k, top_p):
                         counters.inc("serving.retraces")
                         logits, pk, pv, sk, sv = model.decode_paged(
-                            w, tok, pos, bt, pk, pv, sk, sv, kernel=mode)
+                            w, tok, pos, bt, pk, pv, sk, sv, kernel=mode,
+                            mesh=mesh, head_axis=head_axis)
                         nxt, new_keys = sample_next(
                             logits, keys_data, do_sample, temp, top_k,
                             top_p)
                         return nxt, pk, pv, sk, sv, new_keys
-                    fn = jax.jit(decode, donate_argnums=(1, 2, 3, 4))
-                else:
-                    def decode(w, pk, pv, bt, tok, pos, keys_data,
-                               do_sample, temp, top_k, top_p):
-                        counters.inc("serving.retraces")
-                        logits, pk, pv = model.decode_paged(
-                            w, tok, pos, bt, pk, pv, kernel=mode)
-                        nxt, new_keys = sample_next(
-                            logits, keys_data, do_sample, temp, top_k,
-                            top_p)
-                        return nxt, pk, pv, new_keys
-                    fn = jax.jit(decode, donate_argnums=(1, 2))
-                progs[key] = fn
-            self._pdecode_jit = fn
+                    return jax.jit(decode, donate_argnums=(1, 2, 3, 4))
+
+                def decode(w, pk, pv, bt, tok, pos, keys_data,
+                           do_sample, temp, top_k, top_p):
+                    counters.inc("serving.retraces")
+                    logits, pk, pv = model.decode_paged(
+                        w, tok, pos, bt, pk, pv, kernel=mode,
+                        mesh=mesh, head_axis=head_axis)
+                    nxt, new_keys = sample_next(
+                        logits, keys_data, do_sample, temp, top_k,
+                        top_p)
+                    return nxt, pk, pv, new_keys
+                return jax.jit(decode, donate_argnums=(1, 2))
+            self._pdecode_jit = self.arena.program(
+                _model_programs(model), self._prog_key("decode_paged"),
+                build)
         return self._pdecode_jit
 
     def _pcopy(self):
@@ -300,10 +360,7 @@ class PagedLLMEngine(LLMEngine):
         zero beyond (one fixed-shape donated program; the quantized
         variant clones the per-token scale rows alongside the tiles)."""
         if self._pcopy_jit is None:
-            progs = _model_programs(self.model)
-            key = self._prog_key("copy_block")
-            fn = progs.get(key)
-            if fn is None:
+            def build():
                 def _clone_block(pk, pv, src, dst, nvalid):
                     bs = pk.shape[2]
                     valid = (jnp.arange(bs) < nvalid)[None, :, None, None]
@@ -334,14 +391,15 @@ class PagedLLMEngine(LLMEngine):
                         sv = jax.lax.dynamic_update_slice(
                             sv, svb[:, None], (0, dst, 0))
                         return pk, pv, sk, sv
-                    fn = jax.jit(copyb, donate_argnums=(0, 1, 2, 3))
-                else:
-                    def copyb(pk, pv, src, dst, nvalid):
-                        counters.inc("serving.retraces")
-                        return _clone_block(pk, pv, src, dst, nvalid)
-                    fn = jax.jit(copyb, donate_argnums=(0, 1))
-                progs[key] = fn
-            self._pcopy_jit = fn
+                    return jax.jit(copyb, donate_argnums=(0, 1, 2, 3))
+
+                def copyb(pk, pv, src, dst, nvalid):
+                    counters.inc("serving.retraces")
+                    return _clone_block(pk, pv, src, dst, nvalid)
+                return jax.jit(copyb, donate_argnums=(0, 1))
+            self._pcopy_jit = self.arena.program(
+                _model_programs(self.model),
+                self._prog_key("copy_block"), build)
         return self._pcopy_jit
 
     def _pmigrate(self):
@@ -356,10 +414,7 @@ class PagedLLMEngine(LLMEngine):
         releases the migrated request (a severed migration loses
         nothing)."""
         if self._pmigrate_jit is None:
-            progs = _model_programs(self.model)
-            key = self._prog_key("migrate_blocks")
-            fn = progs.get(key)
-            if fn is None:
+            def build():
                 def _gather(spk, spv, src_ids, m5):
                     kb = jnp.take(spk, src_ids, axis=1)
                     vb = jnp.take(spv, src_ids, axis=1)
@@ -385,20 +440,21 @@ class PagedLLMEngine(LLMEngine):
                         sk = sk.at[:, ids].set(skb)
                         sv = sv.at[:, ids].set(svb)
                         return pk, pv, sk, sv
-                    fn = jax.jit(migrate, donate_argnums=(0, 1, 2, 3))
-                else:
-                    def migrate(pk, pv, spk, spv, src_ids, dst_ids, n):
-                        counters.inc("serving.retraces")
-                        m = jnp.arange(src_ids.shape[0]) < n
-                        kb, vb = _gather(spk, spv, src_ids,
-                                         m[None, :, None, None, None])
-                        ids = jnp.where(m, dst_ids, 0)
-                        pk = pk.at[:, ids].set(kb)
-                        pv = pv.at[:, ids].set(vb)
-                        return pk, pv
-                    fn = jax.jit(migrate, donate_argnums=(0, 1))
-                progs[key] = fn
-            self._pmigrate_jit = fn
+                    return jax.jit(migrate, donate_argnums=(0, 1, 2, 3))
+
+                def migrate(pk, pv, spk, spv, src_ids, dst_ids, n):
+                    counters.inc("serving.retraces")
+                    m = jnp.arange(src_ids.shape[0]) < n
+                    kb, vb = _gather(spk, spv, src_ids,
+                                     m[None, :, None, None, None])
+                    ids = jnp.where(m, dst_ids, 0)
+                    pk = pk.at[:, ids].set(kb)
+                    pv = pv.at[:, ids].set(vb)
+                    return pk, pv
+                return jax.jit(migrate, donate_argnums=(0, 1))
+            self._pmigrate_jit = self.arena.program(
+                _model_programs(self.model),
+                self._prog_key("migrate_blocks"), build)
         return self._pmigrate_jit
 
     def _pspill(self):
@@ -408,10 +464,7 @@ class PagedLLMEngine(LLMEngine):
         caller materializes the result into pinned host buffers and only
         then releases the device block."""
         if self._pspill_jit is None:
-            progs = _model_programs(self.model)
-            key = self._prog_key("spill_block")
-            fn = progs.get(key)
-            if fn is None:
+            def build():
                 if self.kv_dtype:
                     def spill(pk, pv, sk, sv, b):
                         counters.inc("serving.retraces")  # trace-time only
@@ -432,9 +485,10 @@ class PagedLLMEngine(LLMEngine):
                         vb = jax.lax.dynamic_slice_in_dim(
                             pv, b, 1, axis=1)[:, 0]
                         return kb, vb
-                fn = jax.jit(spill)
-                progs[key] = fn
-            self._pspill_jit = fn
+                return jax.jit(spill)
+            self._pspill_jit = self.arena.program(
+                _model_programs(self.model),
+                self._prog_key("spill_block"), build)
         return self._pspill_jit
 
     def _prestore(self):
@@ -443,10 +497,7 @@ class PagedLLMEngine(LLMEngine):
         fixed-shape donated dispatch — the exact inverse of
         :meth:`_pspill`, same shape family as the COW clone."""
         if self._prestore_jit is None:
-            progs = _model_programs(self.model)
-            key = self._prog_key("restore_block")
-            fn = progs.get(key)
-            if fn is None:
+            def build():
                 if self.kv_dtype:
                     def restore(pk, pv, sk, sv, kb, vb, skb, svb, b):
                         counters.inc("serving.retraces")  # trace-time only
@@ -459,18 +510,19 @@ class PagedLLMEngine(LLMEngine):
                         sv = jax.lax.dynamic_update_slice(
                             sv, svb[:, None], (0, b, 0))
                         return pk, pv, sk, sv
-                    fn = jax.jit(restore, donate_argnums=(0, 1, 2, 3))
-                else:
-                    def restore(pk, pv, kb, vb, b):
-                        counters.inc("serving.retraces")  # trace-time only
-                        pk = jax.lax.dynamic_update_slice(
-                            pk, kb[:, None], (0, b, 0, 0, 0))
-                        pv = jax.lax.dynamic_update_slice(
-                            pv, vb[:, None], (0, b, 0, 0, 0))
-                        return pk, pv
-                    fn = jax.jit(restore, donate_argnums=(0, 1))
-                progs[key] = fn
-            self._prestore_jit = fn
+                    return jax.jit(restore, donate_argnums=(0, 1, 2, 3))
+
+                def restore(pk, pv, kb, vb, b):
+                    counters.inc("serving.retraces")  # trace-time only
+                    pk = jax.lax.dynamic_update_slice(
+                        pk, kb[:, None], (0, b, 0, 0, 0))
+                    pv = jax.lax.dynamic_update_slice(
+                        pv, vb[:, None], (0, b, 0, 0, 0))
+                    return pk, pv
+                return jax.jit(restore, donate_argnums=(0, 1))
+            self._prestore_jit = self.arena.program(
+                _model_programs(self.model),
+                self._prog_key("restore_block"), build)
         return self._prestore_jit
 
     # -- host-RAM KV tier ----------------------------------------------------
@@ -876,8 +928,8 @@ class PagedLLMEngine(LLMEngine):
         t0_tr = time.perf_counter_ns() if tr is not None else 0
         with span("serving.prefill"):
             pf = self._pchunk_for(C)
-            head = (self._w, jnp.asarray(ids), np.int32(start),
-                    np.int32(take_n), jnp.asarray(self._bt[slot]))
+            head = (self._w, self.arena.operand(ids), np.int32(start),
+                    np.int32(take_n), self.arena.operand(self._bt[slot]))
             tail = (key_data, np.bool_(req.do_sample),
                     np.float32(req.temperature), np.int32(req.top_k),
                     np.float32(req.top_p))
@@ -974,10 +1026,11 @@ class PagedLLMEngine(LLMEngine):
         t0_tr = time.perf_counter_ns() if tr_on else 0
         with span("serving.decode"):
             dec = self._pdecode()
-            tail = (jnp.asarray(bt_eff), jnp.asarray(self._tok),
-                    jnp.asarray(pos_eff), jnp.asarray(self._keys),
-                    jnp.asarray(self._dosample), jnp.asarray(self._temp),
-                    jnp.asarray(self._topk), jnp.asarray(self._topp))
+            op = self.arena.operand
+            tail = (op(bt_eff), op(self._tok),
+                    op(pos_eff), op(self._keys),
+                    op(self._dosample), op(self._temp),
+                    op(self._topk), op(self._topp))
             if self.kv_dtype:
                 dargs = (self._w, self._pk, self._pv, self._sk, self._sv,
                          *tail)
@@ -1339,5 +1392,13 @@ class PagedLLMEngine(LLMEngine):
                                      else self._host_tier.arena_bytes),
                 "tier_spilled": self.kv_tier_spilled,
                 "tier_restored": self.kv_tier_restored,
+                # per-chip HBM actually held by chip 0's shards — under
+                # an mp mesh the KV pools and weight matrices divide by
+                # the axis size, the replicated operands do not
+                "mesh_tag": self.arena.tag or None,
+                "kv_pool_bytes_per_chip": self.arena.device_bytes(
+                    "pool_k", "pool_v", "scale_k", "scale_v"),
+                "weight_bytes_per_chip": self.arena.device_bytes(
+                    "weights"),
             })
         return st

@@ -194,25 +194,35 @@ class SpeculativeLLMEngine(PagedLLMEngine):
         dhd = dc.hidden_size // dnh
         adt = (_pa.KV_DTYPES[self.kv_dtype] if self.kv_dtype
                else jnp.dtype(dc.dtype))
+        from .arena import KV_POOL_SPEC
         if self.weight_dtype == "int8":
             from ..quantization import ptq_int8_decode_state
-            self._dw = ptq_int8_decode_state(self.draft_model)
+            self._dw = self.arena.declare_tree(
+                "draft_weights", ptq_int8_decode_state(self.draft_model))
         else:
-            self._dw = self.draft_model.decode_state()
+            self._dw = self.arena.declare_tree(
+                "draft_weights", self.draft_model.decode_state())
         # the draft's arena shares the pool's BLOCK IDS, not its storage:
         # same n_blocks/block_size geometry, the draft model's own
         # layer/head shape
-        self._dk = jnp.zeros(
-            (dc.num_layers, self.n_blocks, bs, dnh, dhd), adt)
-        self._dv = jnp.zeros(
-            (dc.num_layers, self.n_blocks, bs, dnh, dhd), adt)
+        self.arena.declare(
+            "draft_pool_k",
+            jnp.zeros((dc.num_layers, self.n_blocks, bs, dnh, dhd), adt),
+            spec=KV_POOL_SPEC)
+        self.arena.declare(
+            "draft_pool_v",
+            jnp.zeros((dc.num_layers, self.n_blocks, bs, dnh, dhd), adt),
+            spec=KV_POOL_SPEC)
         if self.kv_dtype:
-            self._dsk = jnp.zeros(
-                (dc.num_layers, self.n_blocks, bs), jnp.float32)
-            self._dsv = jnp.zeros(
-                (dc.num_layers, self.n_blocks, bs), jnp.float32)
+            self.arena.declare(
+                "draft_scale_k",
+                jnp.zeros((dc.num_layers, self.n_blocks, bs), jnp.float32))
+            self.arena.declare(
+                "draft_scale_v",
+                jnp.zeros((dc.num_layers, self.n_blocks, bs), jnp.float32))
         else:
-            self._dsk = self._dsv = None
+            self.arena.declare("draft_scale_k", None)
+            self.arena.declare("draft_scale_v", None)
         key_size = jax.random.key_data(jax.random.key(0)).shape[0]
         self._dkeys = np.zeros((B, key_size), np.uint32)
         self._dbt = np.zeros((B, self.max_blocks), np.int32)
@@ -227,6 +237,40 @@ class SpeculativeLLMEngine(PagedLLMEngine):
         self._spec_drafted = 0
         self._spec_accepted = 0
 
+    # draft pools live in the StateArena like the target's (same rebind
+    # discipline through the donated draft programs)
+    @property
+    def _dk(self):
+        return self.arena.get("draft_pool_k")
+
+    @_dk.setter
+    def _dk(self, v):
+        self.arena.bind("draft_pool_k", v)
+
+    @property
+    def _dv(self):
+        return self.arena.get("draft_pool_v")
+
+    @_dv.setter
+    def _dv(self, v):
+        self.arena.bind("draft_pool_v", v)
+
+    @property
+    def _dsk(self):
+        return self.arena.get("draft_scale_k")
+
+    @_dsk.setter
+    def _dsk(self, v):
+        self.arena.bind("draft_scale_k", v)
+
+    @property
+    def _dsv(self):
+        return self.arena.get("draft_scale_v")
+
+    @_dsv.setter
+    def _dsv(self, v):
+        self.arena.bind("draft_scale_v", v)
+
     def release_kv(self):
         super().release_kv()
         self._dk = self._dv = self._dsk = self._dsv = None
@@ -238,11 +282,9 @@ class SpeculativeLLMEngine(PagedLLMEngine):
         target KV); the chunk's logits are dead and DCE'd."""
         fn = self._dchunk_jits.get(bucket)
         if fn is None:
-            progs = _model_programs(self.draft_model)
-            key = self._prog_key("serving.draft_prefill_paged")
-            fn = progs.get(key)
-            if fn is None:
-                draft = self.draft_model
+            draft = self.draft_model
+
+            def build():
                 if self.kv_dtype:
                     def dchunk(dw, ids, start, length, bt, dk, dv, dsk,
                                dsv):
@@ -250,15 +292,17 @@ class SpeculativeLLMEngine(PagedLLMEngine):
                         dk, dv, dsk, dsv, _ = draft.prefill_paged(
                             dw, ids, start, length, bt, dk, dv, dsk, dsv)
                         return dk, dv, dsk, dsv
-                    fn = jax.jit(dchunk, donate_argnums=(5, 6, 7, 8))
-                else:
-                    def dchunk(dw, ids, start, length, bt, dk, dv):
-                        counters.inc("serving.retraces")  # trace-time only
-                        dk, dv, _ = draft.prefill_paged(
-                            dw, ids, start, length, bt, dk, dv)
-                        return dk, dv
-                    fn = jax.jit(dchunk, donate_argnums=(5, 6))
-                progs[key] = fn
+                    return jax.jit(dchunk, donate_argnums=(5, 6, 7, 8))
+
+                def dchunk(dw, ids, start, length, bt, dk, dv):
+                    counters.inc("serving.retraces")  # trace-time only
+                    dk, dv, _ = draft.prefill_paged(
+                        dw, ids, start, length, bt, dk, dv)
+                    return dk, dv
+                return jax.jit(dchunk, donate_argnums=(5, 6))
+            fn = self.arena.program(
+                _model_programs(draft),
+                self._prog_key("serving.draft_prefill_paged"), build)
             self._dchunk_jits[bucket] = fn
         return fn
 
@@ -267,13 +311,10 @@ class SpeculativeLLMEngine(PagedLLMEngine):
         draw, returning the proposal AND the filtered distribution it was
         drawn from (``q`` — what the acceptance test divides by)."""
         if self._pdraft_jit is None:
-            progs = _model_programs(self.draft_model)
-            key = self._prog_key("serving.draft_paged")
-            fn = progs.get(key)
-            if fn is None:
-                draft = self.draft_model
-                mode = self.kv_kernel
+            draft = self.draft_model
+            mode = self.kv_kernel
 
+            def build():
                 def sample_q(logits, keys_data, do_sample, temp, top_k,
                              top_p):
                     keys = jax.random.wrap_key_data(keys_data)
@@ -301,20 +342,21 @@ class SpeculativeLLMEngine(PagedLLMEngine):
                             logits, keys_data, do_sample, temp, top_k,
                             top_p)
                         return nxt, qdist, dk, dv, dsk, dsv, new_keys
-                    fn = jax.jit(dstep, donate_argnums=(1, 2, 3, 4))
-                else:
-                    def dstep(dw, dk, dv, bt, tok, pos, keys_data,
-                              do_sample, temp, top_k, top_p):
-                        counters.inc("serving.retraces")
-                        logits, dk, dv = draft.decode_paged(
-                            dw, tok, pos, bt, dk, dv, kernel=mode)
-                        nxt, qdist, new_keys = sample_q(
-                            logits, keys_data, do_sample, temp, top_k,
-                            top_p)
-                        return nxt, qdist, dk, dv, new_keys
-                    fn = jax.jit(dstep, donate_argnums=(1, 2))
-                progs[key] = fn
-            self._pdraft_jit = fn
+                    return jax.jit(dstep, donate_argnums=(1, 2, 3, 4))
+
+                def dstep(dw, dk, dv, bt, tok, pos, keys_data,
+                          do_sample, temp, top_k, top_p):
+                    counters.inc("serving.retraces")
+                    logits, dk, dv = draft.decode_paged(
+                        dw, tok, pos, bt, dk, dv, kernel=mode)
+                    nxt, qdist, new_keys = sample_q(
+                        logits, keys_data, do_sample, temp, top_k,
+                        top_p)
+                    return nxt, qdist, dk, dv, new_keys
+                return jax.jit(dstep, donate_argnums=(1, 2))
+            self._pdraft_jit = self.arena.program(
+                _model_programs(draft),
+                self._prog_key("serving.draft_paged"), build)
         return self._pdraft_jit
 
     def _pverify(self):
@@ -325,13 +367,10 @@ class SpeculativeLLMEngine(PagedLLMEngine):
         the program, so the draft loop's outputs feed straight through
         device-to-device."""
         if self._pverify_jit is None:
-            progs = _model_programs(self.model)
-            key = self._prog_key(f"serving.verify_paged[k{self.spec_k}]")
-            fn = progs.get(key)
-            if fn is None:
-                model = self.model
-                K1 = self.spec_k + 1
+            model = self.model
+            K1 = self.spec_k + 1
 
+            def build():
                 if self.kv_dtype:
                     def verify(w, pk, pv, sk, sv, bt, pos0, nv, keys_data,
                                do_sample, temp, top_k, top_p, *tq):
@@ -344,22 +383,24 @@ class SpeculativeLLMEngine(PagedLLMEngine):
                             logits, toks, q, nv, keys_data, do_sample,
                             temp, top_k, top_p)
                         return emit, n_emit, pk, pv, sk, sv, new_keys
-                    fn = jax.jit(verify, donate_argnums=(1, 2, 3, 4))
-                else:
-                    def verify(w, pk, pv, bt, pos0, nv, keys_data,
-                               do_sample, temp, top_k, top_p, *tq):
-                        counters.inc("serving.retraces")
-                        toks = jnp.stack(tq[:K1], axis=1)
-                        q = jnp.stack(tq[K1:], axis=1)
-                        logits, pk, pv = model.verify_paged(
-                            w, toks, pos0, nv, bt, pk, pv)
-                        emit, n_emit, new_keys = _acceptance(
-                            logits, toks, q, nv, keys_data, do_sample,
-                            temp, top_k, top_p)
-                        return emit, n_emit, pk, pv, new_keys
-                    fn = jax.jit(verify, donate_argnums=(1, 2))
-                progs[key] = fn
-            self._pverify_jit = fn
+                    return jax.jit(verify, donate_argnums=(1, 2, 3, 4))
+
+                def verify(w, pk, pv, bt, pos0, nv, keys_data,
+                           do_sample, temp, top_k, top_p, *tq):
+                    counters.inc("serving.retraces")
+                    toks = jnp.stack(tq[:K1], axis=1)
+                    q = jnp.stack(tq[K1:], axis=1)
+                    logits, pk, pv = model.verify_paged(
+                        w, toks, pos0, nv, bt, pk, pv)
+                    emit, n_emit, new_keys = _acceptance(
+                        logits, toks, q, nv, keys_data, do_sample,
+                        temp, top_k, top_p)
+                    return emit, n_emit, pk, pv, new_keys
+                return jax.jit(verify, donate_argnums=(1, 2))
+            self._pverify_jit = self.arena.program(
+                _model_programs(model),
+                self._prog_key(f"serving.verify_paged[k{self.spec_k}]"),
+                build)
         return self._pverify_jit
 
     # -- request intake ------------------------------------------------------
@@ -430,8 +471,8 @@ class SpeculativeLLMEngine(PagedLLMEngine):
         ids[0, :take_n] = tokens[start:start + take_n]
         with span("serving.spec.draft_prefill"):
             df = self._dchunk_for(C)
-            head = (self._dw, jnp.asarray(ids), np.int32(start),
-                    np.int32(take_n), jnp.asarray(self._dbt[slot]))
+            head = (self._dw, self.arena.operand(ids), np.int32(start),
+                    np.int32(take_n), self.arena.operand(self._dbt[slot]))
             if self.kv_dtype:
                 dargs = (*head, self._dk, self._dv, self._dsk, self._dsv)
                 dn = (5, 6, 7, 8)
@@ -612,12 +653,13 @@ class SpeculativeLLMEngine(PagedLLMEngine):
         t0_tr = time.perf_counter_ns() if tr_on else 0
         with span("serving.spec.round"):
             df = self._pdraft()
-            cur = jnp.asarray(self._tok)
-            dkeys = jnp.asarray(self._dkeys)
-            dosample = jnp.asarray(self._dosample)
-            temp = jnp.asarray(self._temp)
-            topk = jnp.asarray(self._topk)
-            topp = jnp.asarray(self._topp)
+            op = self.arena.operand
+            cur = op(self._tok)
+            dkeys = op(self._dkeys)
+            dosample = op(self._dosample)
+            temp = op(self._temp)
+            topk = op(self._topk)
+            topp = op(self._topp)
             ts, qs = [cur], []
             for j in range(K1):
                 part = self._running & dready & (nv > j)
@@ -628,8 +670,8 @@ class SpeculativeLLMEngine(PagedLLMEngine):
                          self._dsv) if self.kv_dtype
                         else (self._dw, self._dk, self._dv))
                 dn = (1, 2, 3, 4) if self.kv_dtype else (1, 2)
-                dargs = (*head, jnp.asarray(bt_eff), cur,
-                         jnp.asarray(pos_j), dkeys, dosample, temp, topk,
+                dargs = (*head, op(bt_eff), cur,
+                         op(pos_j), dkeys, dosample, temp, topk,
                          topp)
                 dname = self._prog_key("serving.draft_paged")
                 if j == 0:
@@ -654,8 +696,8 @@ class SpeculativeLLMEngine(PagedLLMEngine):
             vhead = ((self._w, self._pk, self._pv, self._sk, self._sv)
                      if self.kv_dtype else (self._w, self._pk, self._pv))
             vdn = (1, 2, 3, 4) if self.kv_dtype else (1, 2)
-            vargs = (*vhead, jnp.asarray(bt_eff), jnp.asarray(pos0),
-                     jnp.asarray(nv), jnp.asarray(self._keys), dosample,
+            vargs = (*vhead, op(bt_eff), op(pos0),
+                     op(nv), op(self._keys), dosample,
                      temp, topk, topp, *ts, *qs)
             vname = self._prog_key(f"serving.verify_paged[k{self.spec_k}]")
             self._maybe_capture(vname, vf, *vargs)

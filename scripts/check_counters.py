@@ -1496,6 +1496,94 @@ def run():
                           dt_saved["FLAGS_peak_hbm_gbps"]})
     pdt.reset()
 
+    # ---- mesh-serving gate: tensor-parallel paged decode over the
+    # StateArena.  An mp2 engine must be token-identical to the
+    # single-device engine (greedy AND seeded), hold the zero-steady-
+    # retrace/hydrate/sync economics with dispatch counts unchanged,
+    # carry the KV pool genuinely head-sharded per chip, and prove —
+    # via the auditor's compiled-HLO census under enforce — that every
+    # cross-chip reduction is an in-graph collective (the host never
+    # launches one).
+    import warnings as _warnings
+
+    from jax.sharding import Mesh as _SMesh
+    from paddle_tpu.serving.arena import StateArena  # noqa: F401 (import gate)
+
+    if jax.device_count() >= 2:
+        ms_mesh = _SMesh(np.array(jax.devices()[:2]).reshape(2), ("mp",))
+
+        # unsharded dispatch-count reference over a warm steady window
+        ms_ref_eng = pq_engine()
+        pq_run(ms_ref_eng)                       # warm
+        ms_ref_before = counters.snapshot()
+        pq_run(ms_ref_eng)
+        ms_ref = counters.delta(ms_ref_before)
+
+        ms_eng = pq_engine(mesh=ms_mesh)
+        ms_greedy = pq_run(ms_eng)               # traces the [mp2] programs
+        ms_sampled = pq_run(ms_eng, sampled=True)
+        if ms_greedy != base_greedy:
+            violations["meshserve:greedy_identity"] = (ms_greedy, base_greedy)
+        if ms_sampled != base_sampled:
+            violations["meshserve:sampled_identity"] = (ms_sampled,
+                                                        base_sampled)
+        if counters.get("serving.mesh.spec_degraded"):
+            violations["meshserve:spec_degraded"] = (
+                counters.get("serving.mesh.spec_degraded"), 0)
+        # sharded-shard-shape proof on the KV pool: nh/mp heads per chip
+        ms_shard = ms_eng.arena.shard_shape("pool_k")
+        ms_want = (scfg.num_layers, ms_eng.n_blocks, 4,
+                   scfg.num_heads // 2,
+                   scfg.hidden_size // scfg.num_heads)
+        if ms_shard != ms_want:
+            violations["meshserve:kv_shard_shape"] = (ms_shard, ms_want)
+        # warm steady window: zero retraces/hydrates/syncs, no arena
+        # misses or rebuilds, zero host-launched collectives
+        ms_before = counters.snapshot()
+        pq_run(ms_eng)
+        mssteady = counters.delta(ms_before)
+        for k in ("serving.retraces", "jit.traces", "jit.hydrates",
+                  "jit.syncs", "serving.arena.program_misses",
+                  "serving.arena.program_rebuilds",
+                  "dist.collective_launches"):
+            if mssteady.get(k, 0):
+                violations[f"meshserve:{k}"] = (mssteady.get(k, 0), 0)
+        # dispatch economics unchanged vs the unsharded twin
+        for k in ("serving.decode_steps", "serving.kv.prefill_chunks",
+                  "serving.prefill_batches"):
+            if mssteady.get(k, 0) != ms_ref.get(k, 0):
+                violations[f"meshserve:dispatch:{k}"] = (mssteady.get(k, 0),
+                                                         ms_ref.get(k, 0))
+        # in-graph-collectives-only proof: a fresh mesh engine under
+        # enforce must audit clean, with the allowlisted census > 0
+        from paddle_tpu.analysis import program_audit as _msaudit
+        _msaudit.reset_audited()
+        pflags.set_flags({"FLAGS_program_audit": "enforce"})
+        try:
+            msa_before = counters.snapshot()
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore")
+                msa_eng = pq_engine(mesh=ms_mesh)
+                msa_tokens = pq_run(msa_eng)
+            msa_delta = counters.delta(msa_before)
+        finally:
+            pflags.set_flags({"FLAGS_program_audit": "off"})
+            _msaudit.reset_audited()
+        if msa_tokens != base_greedy:
+            violations["meshserve:audited_identity"] = (msa_tokens,
+                                                        base_greedy)
+        if msa_delta.get("analysis.collectives_in_graph", 0) < 1:
+            violations["meshserve:collectives_in_graph"] = (
+                msa_delta.get("analysis.collectives_in_graph", 0), ">=1")
+        if msa_delta.get("analysis.findings", 0):
+            violations["meshserve:audit_findings"] = (
+                msa_delta.get("analysis.findings", 0), 0)
+        mssteady["analysis.collectives_in_graph"] = msa_delta.get(
+            "analysis.collectives_in_graph", 0)
+    else:
+        mssteady = {"skipped":
+                    f"needs 2 devices, have {jax.device_count()}"}
+
     result = {"metric": "steady_state_counter_violations",
               "value": len(violations),
               "unit": f"violations/{MEASURE} steps "
@@ -1562,6 +1650,8 @@ def run():
                                 "findings": audit_delta.get(
                                     "analysis.findings", 0),
                                 "fixtures": fixture_got},
+              "meshserve_delta": {k: v for k, v in mssteady.items()
+                                  if not k.endswith("_ns")},
               "devicetime": {"off": _pick(dt_off), "on": _pick(dt_on),
                              "off_moved": dt_off_moved,
                              "dispatches": dt_disp,

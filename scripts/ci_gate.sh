@@ -12,7 +12,11 @@
 # and the devicetime phase (sample=0 byte-identical OFF parity;
 # sample=4 pays exactly ceil(dispatches/4) fences with token identity
 # and a ledger whose MFU/roofline gauges survive GET /programs and
-# bench_compare --attribute).
+# bench_compare --attribute), and the mesh-serving phase (mp2 paged
+# decode over the StateArena: token identity vs single-device, zero
+# steady retraces/hydrates/host-syncs with dispatch counts unchanged,
+# the KV pool genuinely head-sharded per chip, and the audit census
+# proving in-graph collectives only — zero host launches).
 #
 # Usage: scripts/ci_gate.sh        (from anywhere; cd's to the repo root)
 set -euo pipefail
@@ -34,7 +38,7 @@ elif [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
-echo "== ci_gate: steady-state counter invariants (incl. disagg, tiering, devicetime) =="
+echo "== ci_gate: steady-state counter invariants (incl. disagg, tiering, devicetime, mesh-serving) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
     python scripts/check_counters.py
 
