@@ -74,6 +74,16 @@ Legs (perf round 5):
   gates token identity, zero steady retraces, per-chip KV+weight bytes
   <= 0.6x the single-chip figure, and decode tok/s >= 0.9x unsharded
   (the 760m flagship on TPU; a 125m CPU-fallback twin off-TPU).
+- gpt125m_multitenant (multi-tenant LoRA serving leg): 6 adapter tenants
+  through a 2-replica fleet whose per-replica AdapterArena holds only 4,
+  so cold tenants page in and the LRU evicts idle ones.  A FAIR
+  round-robin pass (tenants + base rows in one heterogeneous batch) and
+  a NOISY pass (tenant 0 floods, plus an injected ``adapter_load_drop``)
+  report decode tok/s, per-tenant-bucket TTFT/ITL tails, the flood
+  bucket's ITL-p95 skew, and arena traffic (loads / evictions /
+  arena_bytes / routed affinity wins); gates zero lost, token identity
+  across repeats, recovery from the dropped load, and zero steady
+  retraces — ONE compiled decode program serves every tenant mix.
 Every training leg embeds a compact "metrics" block (loss / grad-norm /
 tok/s / step-time / MFU stats from the zero-sync in-graph MetricsLogger
 accumulators) plus a "goodput" block (the profiler.goodput wall-clock
@@ -92,7 +102,7 @@ FLAGS_device_time_sample ledger, captured in a short UNTIMED post-window
 pass so the sampling fences never touch a gated number) —
 ``bench_compare.py --attribute`` diffs these shares to name the program
 behind any regression.
-Set PTPU_BENCH=125m|760m|serve|paged|paged_q|tiered|spec|ckpt|fleet|disagg|mesh|mesh760m|servemp
+Set PTPU_BENCH=125m|760m|serve|paged|paged_q|tiered|spec|ckpt|fleet|disagg|mesh|mesh760m|servemp|multitenant
 to run a single leg.  PTPU_FUSED_STEPS sets the fused window length K (default 4; 1
 disables the fused leg).  PTPU_MESH picks the mesh leg's axis degrees.
 """
@@ -1029,6 +1039,151 @@ def _run_fleet_leg(cfg, replicas=2, n_requests=8, max_new=32, max_slots=4,
     return leg
 
 
+def _run_multitenant_leg(cfg, replicas=2, tenants=6, adapter_slots=4,
+                         rank=8, n_requests=12, max_new=32, max_slots=4,
+                         min_bucket=8, block_size=16, prefill_chunk=None,
+                         seed=0):
+    """Multi-tenant LoRA serving leg: ``tenants`` adapters through a
+    ``replicas``-replica fleet whose per-replica AdapterArena holds only
+    ``adapter_slots`` of them, so cold tenants page in on demand and the
+    LRU evicts idle ones — many model variants at the HBM cost of a few.
+    Two measured passes: FAIR (tenants round-robin with base rows mixed
+    in) and NOISY (tenant 0 floods the fleet while the others get one
+    request each, plus an injected ``adapter_load_drop`` on one
+    admission).  Reports decode tokens/s for both, per-tenant-bucket
+    TTFT/ITL tails from the router-merged histograms, the noisy pass's
+    flood-bucket ITL-p95 skew, arena traffic (loads / evictions /
+    resident / bytes) and the router's tenant-affinity wins; gates zero
+    lost requests, the dropped load recovering to a finished
+    token-identical request, paging genuinely exercised (loads AND
+    evictions move), and the fair pass token-identical across repeats
+    with ZERO steady retraces — one compiled decode program serves every
+    tenant mix."""
+    import zlib
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.profiler import counters
+    from paddle_tpu.resilience import faultinject
+    from paddle_tpu.serving import ServingFleet
+    from paddle_tpu.serving.adapters import random_lora_factors
+
+    paddle.seed(seed)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(seed)
+    S = cfg.max_seq_len
+    lens = [int(rng.randint(max(2, S // 16), S - max_new))
+            for _ in range(n_requests)]
+    prompts = [rng.randint(0, cfg.vocab_size, size=n).tolist()
+               for n in lens]
+    seeds = list(range(100, 100 + n_requests))
+    names = [f"tenant{i}" for i in range(tenants)]
+    # fair mix: tenants round-robin, every (tenants+1)-th row base; noisy
+    # mix: tenant 0 floods, every other tenant trickles one request, and
+    # the LAST row is a tenant no pass has touched — its admission MUST
+    # page in, so the adapter_load_drop scheduled on it always fires
+    cold = "coldspare"
+    fair = [None if i % (tenants + 1) == tenants
+            else names[i % (tenants + 1)] for i in range(n_requests)]
+    noisy = ([names[0]] * (n_requests - tenants)) + names[1:] + [cold]
+
+    fleet = ServingFleet(model, replicas=replicas, max_slots=max_slots,
+                         max_seq_len=S, min_bucket=min_bucket,
+                         threaded=False, warm_buckets=lens,
+                         kv_layout="paged", block_size=block_size,
+                         prefill_chunk=prefill_chunk,
+                         adapter_slots=adapter_slots, adapter_rank=rank)
+    for i, t in enumerate(names + [cold]):
+        fleet.register_adapter(
+            t, random_lora_factors(cfg, rank, seed=10 + i, scale=0.05))
+
+    def run_pass(mix, drop_on_last=False):
+        before = counters.snapshot()
+        t0 = time.perf_counter()
+        hs = [fleet.submit(p, max_new_tokens=max_new, seed=s, adapter=t)
+              for p, s, t in zip(prompts, seeds, mix)]
+        if drop_on_last:
+            # the engine-side load fires at admission inside pump(), so
+            # scheduling after submit still intercepts it
+            with faultinject.fault_schedule(
+                    f"adapter_load_drop@{hs[-1]._er.rid}"):
+                fleet.join(hs)
+                fired = [s for s, _ in faultinject.fired]
+        else:
+            fleet.join(hs)
+            fired = []
+        dt = time.perf_counter() - t0
+        return hs, dt, counters.delta(before), fired
+
+    run_pass(fair)  # warm pass: programs compiled, tenants paged once
+    warm_hs, _, _, _ = run_pass(fair)  # identity reference (same seeds)
+    fair_hs, fair_s, fair_d, _ = run_pass(fair)
+    hist_mark = fleet.router.aggregate_histograms(fleet._replicas)
+    noisy_hs, noisy_s, noisy_d, fired = run_pass(noisy, drop_on_last=True)
+    agg = fleet.router.aggregate_histograms(fleet._replicas)
+    stats = fleet.stats()
+    fleet.drain()
+
+    match = all(f.finish_reason == "length" and f.tokens == w.tokens
+                for f, w in zip(fair_hs, warm_hs))
+    drop_ok = (noisy_hs[-1].finish_reason == "length"
+               and "adapter_load_drop" in fired)
+    # per-tenant-bucket tails (cumulative) + the noisy pass's windowed
+    # flood-bucket skew: flood p95 vs the median p95 of the other buckets
+    n_buckets = fleet._replicas[0].engine.tenant_buckets
+    flood = f"t{zlib.crc32(names[0].encode()) % n_buckets}"
+    per_tenant = {
+        name.rsplit(".", 1)[-1]: _latency_ms(h)
+        for name, h in sorted(agg.items())
+        if name.startswith("serving.itl_ns.tenant.")}
+    win, skew = {}, None
+    for name, h in agg.items():
+        if name.startswith("serving.itl_ns.tenant."):
+            prev = hist_mark.get(name)
+            d = h.delta(prev) if prev is not None else h
+            if d.count >= 8:
+                win[name.rsplit(".", 1)[-1]] = d.percentile(95)
+    others = sorted(v for k, v in win.items() if k != flood)
+    if flood in win and others:
+        skew = round(win[flood] / max(others[len(others) // 2], 1e-9), 3)
+    ad = stats["adapters"]
+    decode_tokens = n_requests * max_new
+    fair_tps = decode_tokens / max(fair_s, 1e-9)
+    noisy_tps = decode_tokens / max(noisy_s, 1e-9)
+    leg = {"replicas": replicas,
+           "tenants": tenants,
+           "adapter_slots_per_replica": adapter_slots,
+           "adapter_rank": rank,
+           "requests": n_requests,
+           "max_new_tokens": max_new,
+           "decode_tokens_per_sec": round(fair_tps, 2),
+           "noisy_decode_tokens_per_sec": round(noisy_tps, 2),
+           "tenants_per_slot": round(tenants / adapter_slots, 2),
+           "arena_bytes": ad["arena_bytes"],
+           "resident": ad["resident"],
+           "loads": ad["loads"],
+           "evictions": ad["evictions"],
+           "exhausted_defers": ad["exhausted"],
+           "load_drops": ad["load_drops"],
+           "adapter_routed": ad["routed"],
+           "steady_retraces": fair_d.get("serving.retraces", 0),
+           "outputs_match_warm": match,
+           "noisy_itl_p95_skew": skew,
+           "ttft": _latency_ms(agg["serving.ttft_ns"]),
+           "itl": _latency_ms(agg["serving.itl_ns"]),
+           "per_tenant_itl": per_tenant}
+    leg["lost"] = (fair_d.get("serving.fleet.lost", 0)
+                   + noisy_d.get("serving.fleet.lost", 0))
+    if (not match or not drop_ok or leg["steady_retraces"] != 0
+            or leg["lost"] != 0 or leg["loads"] < tenants
+            or leg["evictions"] < 1):
+        raise AssertionError(
+            f"multitenant leg broke the adapter-serving invariants: {leg}")
+    del fleet, model
+    return leg
+
+
 def _run_disagg_leg(cfg, n_long=6, n_short=18, max_new=16, max_slots=None,
                     min_bucket=8, block_size=8, prefill_chunk=16,
                     min_speedup=1.3, seed=0):
@@ -1808,6 +1963,13 @@ def main():
         out["disagg"] = _run_disagg_leg(cfg, n_long=4, n_short=12,
                                         max_new=32, min_bucket=4,
                                         block_size=8, prefill_chunk=16)
+        # tiny multi-tenant adapter leg: identity / zero-lost /
+        # load-drop-recovery / paging gates always; throughput and
+        # noisy-neighbor skew informational on CPU
+        out["multitenant"] = _run_multitenant_leg(
+            cfg, replicas=2, tenants=6, adapter_slots=4, rank=4,
+            n_requests=12, max_new=16, max_slots=4, min_bucket=4,
+            block_size=4, prefill_chunk=16)
         # tiny mesh leg: steady-state counter gates on the multi-chip
         # SPMD path always; scaling efficiency is informational on
         # forced-host CPU "devices" (they share the same cores)
@@ -1822,11 +1984,11 @@ def main():
     which = os.environ.get("PTPU_BENCH", "all")
     if which not in ("all", "760m", "125m", "serve", "paged", "paged_q",
                      "tiered", "spec", "ckpt", "fleet", "disagg", "mesh",
-                     "mesh760m", "servemp"):
+                     "mesh760m", "servemp", "multitenant"):
         raise SystemExit(
             f"PTPU_BENCH={which!r}: expected "
             f"all|760m|125m|serve|paged|paged_q|tiered|spec|ckpt|fleet|"
-            f"disagg|mesh|mesh760m|servemp")
+            f"disagg|mesh|mesh760m|servemp|multitenant")
     mesh_degrees = _parse_mesh_degrees(os.environ.get("PTPU_MESH", "dp2"))
     mesh_ndev = int(np.prod(list(mesh_degrees.values())))
     legs = {}
@@ -1950,6 +2112,21 @@ def main():
         legs["gpt125m_fleet"] = _run_fleet_leg(fcfg, replicas=2,
                                                n_requests=8, max_new=64,
                                                max_slots=4)
+    if which in ("all", "multitenant"):
+        # multi-tenant adapter leg: 6 LoRA tenants through a 2-replica
+        # fleet whose per-replica arena holds 4 — cold tenants page in,
+        # LRU evicts idle (acceptance: fair pass token-identical across
+        # repeats with zero steady retraces, zero lost, the injected
+        # adapter_load_drop recovering to a finished request, and
+        # loads/evictions both moving — paging genuinely exercised)
+        mtcfg = GPTConfig.gpt3_125m(vocab_size=50304, max_seq_len=1024,
+                                    dtype="bfloat16",
+                                    use_flash_attention=False,
+                                    recompute=None)
+        legs["gpt125m_multitenant"] = _run_multitenant_leg(
+            mtcfg, replicas=2, tenants=6, adapter_slots=4, rank=8,
+            n_requests=12, max_new=64, max_slots=4, block_size=16,
+            prefill_chunk=256)
     if which in ("all", "disagg"):
         # disaggregated prefill/decode leg: 1+1 split vs 2-replica
         # unified on mixed long/short traffic (acceptance: >=1.3x p95
@@ -2034,6 +2211,17 @@ def main():
             "value": leg["decode_tokens_per_sec"],
             "unit": "tokens/s",
             "vs_baseline": leg["churn_retention"],  # vs one replica killed
+            "legs": legs,
+        }))
+        return
+    if set(legs) == {"gpt125m_multitenant"}:  # adapters-only: tenant line
+        leg = legs["gpt125m_multitenant"]
+        print(json.dumps({
+            "metric": "gpt125m_multitenant_decode_tokens_per_sec",
+            "value": leg["decode_tokens_per_sec"],
+            "unit": "tokens/s (6 tenants + base, one decode program)",
+            "vs_baseline": leg["tenants_per_slot"],  # variants per slot
+            "noisy_itl_p95_skew": leg["noisy_itl_p95_skew"],
             "legs": legs,
         }))
         return

@@ -183,6 +183,28 @@ def _mm(x, lw, name):
     return (y * s).astype(x.dtype)
 
 
+def _mm_lora(x, lw, name, al, aids):
+    """:func:`_mm` plus the gathered batched low-rank update (S-LoRA /
+    Punica): ``y + x @ A[ids] @ B[ids]`` where ``al`` holds this layer's
+    adapter slabs ``a_<name> [n_slots, d_in, R]`` / ``b_<name>
+    [n_slots, R, d_out]`` and ``aids [B]`` is the per-row int32 adapter
+    slot — an OPERAND, so one compiled program serves any tenant mix.
+    Slot 0 is the base model: its slab rows are zeros AND the row's
+    output is selected from the un-adapted ``y`` itself (not ``y + 0``),
+    so base rows are bitwise identical to an adapter-free program.
+    Composes with the int8 epilogue untouched — the low-rank branch runs
+    beside whatever ``_mm`` produced."""
+    y = _mm(x, lw, name)
+    if al is None:
+        return y
+    prec = matmul_precision()
+    ag = al["a_" + name][aids]                        # [B, d_in, R]
+    bg = al["b_" + name][aids]                        # [B, R, d_out]
+    d = jnp.einsum("bti,bir->btr", x, ag, precision=prec)
+    d = jnp.einsum("btr,bro->bto", d, bg, precision=prec)
+    return jnp.where((aids > 0)[:, None, None], y + d.astype(y.dtype), y)
+
+
 class GPTForCausalLM(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
@@ -646,7 +668,8 @@ class GPTForCausalLM(Layer):
         return logits, cache_k, cache_v
 
     def prefill_paged(self, w, ids, start, length, bt, pool_k, pool_v,
-                      scale_k=None, scale_v=None):
+                      scale_k=None, scale_v=None, adapters=None,
+                      adapter_ids=None):
         """One chunked-prefill step over a block-pool KV arena (the paged
         twin of ``prefill_slot``; see ``serving.paged``).
 
@@ -694,14 +717,22 @@ class GPTForCausalLM(Layer):
         quant = scale_k is not None
         kv_dt = _pa.kv_dtype_of(pool_k.dtype) if quant else None
 
+        lora = adapters is not None
+        aids = adapter_ids
+
         def body(hh, xs):
-            if quant:
-                lw, ck, cv, sk, sv = xs
+            if lora:
+                lw, al, *rest = xs
             else:
-                lw, ck, cv = xs
+                al = None
+                lw, *rest = xs
+            if quant:
+                ck, cv, sk, sv = rest
+            else:
+                ck, cv = rest
                 sk = sv = None
             x = _norm(hh, lw["ln1_w"], lw["ln1_b"], eps)
-            qkv = _mm(x, lw, "qkv_w") + lw["qkv_b"]
+            qkv = _mm_lora(x, lw, "qkv_w", al, aids) + lw["qkv_b"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, C, nh, hd)
             k = k.reshape(B, C, nh, hd)
@@ -743,7 +774,7 @@ class GPTForCausalLM(Layer):
             p = jax.nn.softmax(logits, axis=-1)
             o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(gv.dtype), gv)
             o = o.reshape(B, C, H).astype(hh.dtype)
-            a = _mm(o, lw, "proj_w") + lw["proj_b"]
+            a = _mm_lora(o, lw, "proj_w", al, aids) + lw["proj_b"]
             hh = hh + a
             x = _norm(hh, lw["ln2_w"], lw["ln2_b"], eps)
             if c.num_experts > 0:
@@ -753,16 +784,19 @@ class GPTForCausalLM(Layer):
                     lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
                     capacity_factor=c.moe_capacity_factor)
             else:
-                up = _mm(x, lw, "fc1_w") + lw["fc1_b"]
-                f = _mm(jax.nn.gelu(up), lw, "fc2_w") + lw["fc2_b"]
+                up = _mm_lora(x, lw, "fc1_w", al, aids) + lw["fc1_b"]
+                f = _mm_lora(jax.nn.gelu(up), lw, "fc2_w", al,
+                             aids) + lw["fc2_b"]
             return hh + f, ((ck, cv, sk, sv) if quant else (ck, cv))
 
+        xs = ((w["lws"], adapters) if lora else (w["lws"],)) \
+            + ((pool_k, pool_v, scale_k, scale_v) if quant
+               else (pool_k, pool_v))
         if quant:
             h, (pool_k, pool_v, scale_k, scale_v) = jax.lax.scan(
-                body, h, (w["lws"], pool_k, pool_v, scale_k, scale_v))
+                body, h, xs)
         else:
-            h, (pool_k, pool_v) = jax.lax.scan(body, h,
-                                               (w["lws"], pool_k, pool_v))
+            h, (pool_k, pool_v) = jax.lax.scan(body, h, xs)
         h_last = jax.lax.dynamic_slice_in_dim(h, length - 1, 1, axis=1)
         logits = _lm_logits(c, w["wte"], w["lnf_w"], w["lnf_b"], w["head"],
                             h_last[:, 0])
@@ -772,7 +806,8 @@ class GPTForCausalLM(Layer):
 
     def decode_paged(self, w, tok, pos, bt, pool_k, pool_v,
                      scale_k=None, scale_v=None, kernel=None,
-                     mesh=None, head_axis=None):
+                     mesh=None, head_axis=None, adapters=None,
+                     adapter_ids=None):
         """One decode step for B slot rows over the block-pool arena (the
         paged twin of ``decode_slots`` — identical math, the arena row is
         replaced by a block-table gather).
@@ -825,14 +860,22 @@ class GPTForCausalLM(Layer):
             raise ValueError(f"decode_paged: kernel={mode!r}")
         _pa.note_program(mode)
 
+        lora = adapters is not None
+        aids = adapter_ids
+
         def body(hh, xs):
-            if quant:
-                lw, ck, cv, sk, sv = xs
+            if lora:
+                lw, al, *rest = xs
             else:
-                lw, ck, cv = xs
+                al = None
+                lw, *rest = xs
+            if quant:
+                ck, cv, sk, sv = rest
+            else:
+                ck, cv = rest
                 sk = sv = None
             x = _norm(hh, lw["ln1_w"], lw["ln1_b"], eps)
-            qkv = _mm(x, lw, "qkv_w") + lw["qkv_b"]
+            qkv = _mm_lora(x, lw, "qkv_w", al, aids) + lw["qkv_b"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, 1, nh, hd)
             k = k.reshape(B, 1, nh, hd)
@@ -879,7 +922,7 @@ class GPTForCausalLM(Layer):
                 o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(gv.dtype), gv)
                 o = o.reshape(B, 1, H)
             o = o.astype(hh.dtype)
-            a = _mm(o, lw, "proj_w") + lw["proj_b"]
+            a = _mm_lora(o, lw, "proj_w", al, aids) + lw["proj_b"]
             hh = hh + a
             x = _norm(hh, lw["ln2_w"], lw["ln2_b"], eps)
             if c.num_experts > 0:
@@ -889,16 +932,19 @@ class GPTForCausalLM(Layer):
                     lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
                     capacity_factor=c.moe_capacity_factor)
             else:
-                up = _mm(x, lw, "fc1_w") + lw["fc1_b"]
-                f = _mm(jax.nn.gelu(up), lw, "fc2_w") + lw["fc2_b"]
+                up = _mm_lora(x, lw, "fc1_w", al, aids) + lw["fc1_b"]
+                f = _mm_lora(jax.nn.gelu(up), lw, "fc2_w", al,
+                             aids) + lw["fc2_b"]
             return hh + f, ((ck, cv, sk, sv) if quant else (ck, cv))
 
+        xs = ((w["lws"], adapters) if lora else (w["lws"],)) \
+            + ((pool_k, pool_v, scale_k, scale_v) if quant
+               else (pool_k, pool_v))
         if quant:
             h, (pool_k, pool_v, scale_k, scale_v) = jax.lax.scan(
-                body, h, (w["lws"], pool_k, pool_v, scale_k, scale_v))
+                body, h, xs)
         else:
-            h, (pool_k, pool_v) = jax.lax.scan(
-                body, h, (w["lws"], pool_k, pool_v))
+            h, (pool_k, pool_v) = jax.lax.scan(body, h, xs)
         logits = _lm_logits(c, w["wte"], w["lnf_w"], w["lnf_b"], w["head"],
                             h[:, 0])
         if quant:
@@ -906,7 +952,8 @@ class GPTForCausalLM(Layer):
         return logits, pool_k, pool_v
 
     def verify_paged(self, w, toks, pos0, n_valid, bt, pool_k, pool_v,
-                     scale_k=None, scale_v=None):
+                     scale_k=None, scale_v=None, adapters=None,
+                     adapter_ids=None):
         """Speculative-decoding verify step: score K+1 token positions
         per row in ONE program over the block-pool arena (the multi-query
         sibling of ``decode_paged``; see ``serving.speculative``).
@@ -956,14 +1003,22 @@ class GPTForCausalLM(Layer):
         quant = scale_k is not None
         kv_dt = _pa.kv_dtype_of(pool_k.dtype) if quant else None
 
+        lora = adapters is not None
+        aids = adapter_ids
+
         def body(hh, xs):
-            if quant:
-                lw, ck, cv, sk, sv = xs
+            if lora:
+                lw, al, *rest = xs
             else:
-                lw, ck, cv = xs
+                al = None
+                lw, *rest = xs
+            if quant:
+                ck, cv, sk, sv = rest
+            else:
+                ck, cv = rest
                 sk = sv = None
             x = _norm(hh, lw["ln1_w"], lw["ln1_b"], eps)
-            qkv = _mm(x, lw, "qkv_w") + lw["qkv_b"]
+            qkv = _mm_lora(x, lw, "qkv_w", al, aids) + lw["qkv_b"]
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, K1, nh, hd)
             k = k.reshape(B, K1, nh, hd)
@@ -998,7 +1053,7 @@ class GPTForCausalLM(Layer):
             p = jax.nn.softmax(logits, axis=-1)
             o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(gv.dtype), gv)
             o = o.reshape(B, K1, H).astype(hh.dtype)
-            a = _mm(o, lw, "proj_w") + lw["proj_b"]
+            a = _mm_lora(o, lw, "proj_w", al, aids) + lw["proj_b"]
             hh = hh + a
             x = _norm(hh, lw["ln2_w"], lw["ln2_b"], eps)
             if c.num_experts > 0:
@@ -1008,16 +1063,19 @@ class GPTForCausalLM(Layer):
                     lw["fc2_w"], lw["fc2_b"], top_k=c.moe_top_k,
                     capacity_factor=c.moe_capacity_factor)
             else:
-                up = _mm(x, lw, "fc1_w") + lw["fc1_b"]
-                f = _mm(jax.nn.gelu(up), lw, "fc2_w") + lw["fc2_b"]
+                up = _mm_lora(x, lw, "fc1_w", al, aids) + lw["fc1_b"]
+                f = _mm_lora(jax.nn.gelu(up), lw, "fc2_w", al,
+                             aids) + lw["fc2_b"]
             return hh + f, ((ck, cv, sk, sv) if quant else (ck, cv))
 
+        xs = ((w["lws"], adapters) if lora else (w["lws"],)) \
+            + ((pool_k, pool_v, scale_k, scale_v) if quant
+               else (pool_k, pool_v))
         if quant:
             h, (pool_k, pool_v, scale_k, scale_v) = jax.lax.scan(
-                body, h, (w["lws"], pool_k, pool_v, scale_k, scale_v))
+                body, h, xs)
         else:
-            h, (pool_k, pool_v) = jax.lax.scan(
-                body, h, (w["lws"], pool_k, pool_v))
+            h, (pool_k, pool_v) = jax.lax.scan(body, h, xs)
         logits = _lm_logits(c, w["wte"], w["lnf_w"], w["lnf_b"], w["head"],
                             h)
         if quant:

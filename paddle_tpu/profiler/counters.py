@@ -116,6 +116,21 @@ Well-known names (see README "Observability" for the full table):
       cap) / serving.arena.program_rebuilds (evicted keys compiled
       AGAIN — the retrace-accounting signal; MUST be 0 in steady state)
   serving.arena.programs (gauge: live programs the arena fronts)
+  serving.adapter.hits / serving.adapter.misses (multi-tenant LoRA
+      acquisitions served by a resident slot vs needing a page-in)
+  serving.adapter.loads (tenant factor page-ins: ONE cached donated
+      dispatch each — eviction-then-reuse never retraces)
+  serving.adapter.evictions (refcount-0 LRU tenants displaced to make
+      room for a cold page-in)
+  serving.adapter.arena_exhausted (admissions deferred because every
+      adapter slot is referenced by a running request)
+  serving.adapter.load_drops (page-ins severed by the adapter_load_drop
+      fault BEFORE any slab write; the request defers, refcounts
+      reconcile, no tenant ever sees another tenant's weights)
+  serving.adapter.resident (gauge: tenants currently device-resident)
+  serving.adapter.arena_bytes (gauge: A/B slab HBM footprint per chip)
+  serving.fleet.adapter_routed (dispatches won by tenant affinity — the
+      winning replica already held the request's adapter)
   kernels.paged.pallas_programs / kernels.paged.xla_fallbacks
       (trace-time: paged decode programs compiled with the fused Pallas
       backend vs the plain-XLA gather twin; 0 in steady state)
@@ -162,6 +177,10 @@ Latency *distributions* (serving.ttft_ns, serving.itl_ns,
 serving.queue_wait_ns, io.prefetch_stall_ns, resilience.save_ms, ...)
 live in profiler.metrics histograms; the migrated ``*_ns``/``*_ms``
 names above keep ticking here as plain sums for back-compat.
+Multi-tenant serving adds per-tenant-bucket isolation histograms
+(serving.ttft_ns.tenant.<bucket> and serving.itl_ns.tenant.<bucket>,
+bucket = "base" or a crc32 hash bucket "t<n>") — the health plane's
+noisy_neighbor watchdog reads their windowed p95s.
 """
 
 from __future__ import annotations

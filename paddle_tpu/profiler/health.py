@@ -426,6 +426,34 @@ def _wd_prefetch_stall(w, monitor):
                                          "ratio": ratio}
 
 
+def _wd_noisy_neighbor(w, monitor):
+    """Multi-tenant isolation: one tenant bucket's windowed ITL p95 is a
+    multiple of the other buckets' median — a neighbor's burn is sinking
+    its SLO.  Reads the ``serving.itl_ns.tenant.<bucket>`` histograms the
+    adapter-serving engine feeds per emitted token; needs >= 2 buckets
+    with real traffic (>= 8 samples each) in the window, so single-tenant
+    or idle fleets can never flap it."""
+    p95s = {}
+    for name in w.end.hists:
+        if not name.startswith("serving.itl_ns.tenant."):
+            continue
+        h = w.hist_delta(name)
+        if h is None or h.count < 8:
+            continue
+        p95s[name.rsplit(".", 1)[-1]] = h.percentile(95)
+    if len(p95s) < 2:
+        return False, {}
+    worst_bucket = max(p95s, key=p95s.get)
+    worst = p95s[worst_bucket]
+    rest = sorted(v for k, v in p95s.items() if k != worst_bucket)
+    med = rest[len(rest) // 2]
+    firing = med > 0 and worst >= 4.0 * med
+    return firing, {"worst_bucket": worst_bucket,
+                    "worst_p95_ns": worst,
+                    "median_other_p95_ns": med,
+                    "buckets": len(p95s)}
+
+
 def default_slos():
     """The serving SLO objectives (targets sized for the CPU test scale
     the repo's gates run at; production deployments pass their own)."""
@@ -456,6 +484,7 @@ def default_watchdogs():
         Watchdog("kv_tier_occupancy", _wd_kv_tier_occupancy),
         Watchdog("goodput_accounted", _wd_goodput_accounted),
         Watchdog("spec_acceptance", _wd_spec_acceptance),
+        Watchdog("noisy_neighbor", _wd_noisy_neighbor),
         Watchdog("prefetch_stall", _wd_prefetch_stall),
         Watchdog("mfu_collapse", _wd_mfu_collapse),
         Watchdog("device_time_regression", _wd_device_time_regression),

@@ -85,6 +85,14 @@ Well-known sites
                      (the tail-sampling chaos site).  Queried via
                      :func:`take` (the replica stalls rather than
                      raises).
+``adapter_load_drop``  LoRA adapter page-in fails mid-admission of
+                     request ``index`` (engine rid): the slot is handed
+                     back BEFORE any slab write — the request can never
+                     see another tenant's weights — and admission defers
+                     queued-with-backoff exactly like
+                     ``kv_pool_exhausted``; arena refcounts reconcile.
+                     Queried via :func:`take` (the engine defers rather
+                     than raises).
 ===================  ====================================================
 
 Every fired fault is appended to :data:`fired` (``(site, index)`` tuples)
@@ -145,6 +153,7 @@ _EXC = {
     "kv_migrate_drop": InjectedFault,
     "kv_spill_drop": InjectedFault,       # consumed via take(); never raised
     "slow_decode": InjectedFault,         # consumed via take(); never raised
+    "adapter_load_drop": InjectedFault,   # consumed via take(); never raised
 }
 
 _LOCK = threading.Lock()
@@ -262,7 +271,7 @@ _flags.define_flag(
     "'site@index[*count];...' with sites ckpt_write/ckpt_crash/preempt/"
     "loader/nan_loss/serving_prefill/replica_crash/decode_stall/"
     "slow_decode/router_queue/kv_pool_exhausted/kv_migrate_drop/"
-    "kv_spill_drop (see paddle_tpu.resilience.faultinject).  Empty "
-    "disables injection.")
+    "kv_spill_drop/adapter_load_drop (see "
+    "paddle_tpu.resilience.faultinject).  Empty disables injection.")
 _flags.register_flag_observer("FLAGS_fault_schedule",
                               lambda v: set_schedule(v or None))

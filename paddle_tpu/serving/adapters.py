@@ -1,0 +1,380 @@
+"""Multi-tenant LoRA adapter arena for the paged serving engine
+(S-LoRA / Punica style: one base model, thousands of low-rank variants).
+
+The PagedAttention lesson — move identity from program *shape* into
+int32 *operands* — applies to model identity too.  The
+:class:`AdapterArena` is a donated device arena of paged low-rank
+factor slabs, one pair per adapted matmul::
+
+    adapter_a_<t>  [L, n_slots, d_in, R]     adapter_b_<t>  [L, n_slots, R, d_out]
+
+for ``t`` in ``qkv_w / proj_w / fc1_w / fc2_w``, rank-padded to a fixed
+``R`` so every tenant rides the same shapes.  Per-row int32
+``adapter_ids`` travel with every prefill/decode/verify dispatch as
+OPERANDS, and ``models.gpt._mm_lora`` applies the gathered batched
+update ``x @ A[ids] @ B[ids]`` beside the (possibly int8) base matmul —
+ONE compiled decode program serves any mix of tenants with zero
+steady-state retraces.  Slot 0 is the base model: its slab rows are
+zeros and the model selects the un-adapted product itself for id-0
+rows, so base traffic is bitwise identical to an adapter-free engine.
+
+Slots are managed with the same refcount + LRU machinery as
+``kvcache.BlockPool``: admission acquires the request's adapter
+(refcount++, cold tenants page in from the host registry through ONE
+cached donated load program), completion releases it, and a refcount-0
+resident is an LRU eviction candidate when the arena runs dry.  A full
+arena raises :class:`AdapterArenaExhausted` — the paged engine converts
+it into the same queued-with-backpressure contract as KV reservation.
+The ``adapter_load_drop`` fault injects a page-in failure *before* any
+slab write, so a dropped load can never leave another tenant's weights
+behind the slot.
+
+Slabs are declared through the engine's :class:`~.arena.StateArena` —
+they ride the donation/rebind protocol and the compile-cache counters —
+and stay REPLICATED on a mesh: the low-rank factors are tiny next to
+the base weights, and replicating them keeps the gathered update free
+of resharding transfers whatever the tensor-parallel layout.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..profiler import counters
+from ..resilience import faultinject as _fi
+
+__all__ = ["AdapterArena", "AdapterArenaExhausted", "ADAPTER_TARGETS",
+           "random_lora_factors"]
+
+#: the adapted matmuls, in slab order (matches ``gpt._mm_lora`` names).
+ADAPTER_TARGETS = ("qkv_w", "proj_w", "fc1_w", "fc2_w")
+
+#: router cost-model bonus (in tokens) for a replica whose arena already
+#: holds the request's adapter — roughly what a cold page-in costs in
+#: queue-delay terms; same currency as the prefix-cache peek.
+ADAPTER_PEEK_TOKENS = 32
+
+
+class AdapterArenaExhausted(RuntimeError):
+    """Adapter acquisition refused: every tenant slot is referenced by a
+    running request (or the ``adapter_load_drop`` fault fired mid
+    page-in).  The paged engine converts this into admission deferral —
+    the request parks at the queue head and retries as slots free — so
+    it must never crash the scheduler or strand a refcount."""
+
+    def __init__(self, msg="", needed=0, free=0):
+        super().__init__(msg)
+        self.needed = int(needed)
+        self.free = int(free)
+
+
+def _target_dims(config):
+    H, F = config.hidden_size, config.ffn_hidden_size
+    return {"qkv_w": (H, 3 * H), "proj_w": (H, H),
+            "fc1_w": (H, F), "fc2_w": (F, H)}
+
+
+def random_lora_factors(config, rank, seed=0, scale=0.05,
+                        targets=ADAPTER_TARGETS):
+    """Seeded random LoRA factors for ``config`` (tests/bench): a flat
+    ``{"a_<t>": [L, d_in, rank], "b_<t>": [L, rank, d_out]}`` dict."""
+    rng = np.random.RandomState(seed)
+    dims = _target_dims(config)
+    L = config.num_layers
+    out = {}
+    for t in targets:
+        di, do = dims[t]
+        out["a_" + t] = (rng.standard_normal((L, di, rank))
+                        * scale).astype(np.float32)
+        out["b_" + t] = (rng.standard_normal((L, rank, do))
+                        * scale).astype(np.float32)
+    return out
+
+
+class AdapterArena:
+    """Paged device arena of per-tenant LoRA factor slabs.
+
+    ``slots`` tenant slots (row 0 is reserved for the base model, so the
+    slab row axis is ``slots + 1``), fixed rank ``rank``; factors are
+    registered host-side (:meth:`register`) and paged into a device slot
+    on first :meth:`acquire`.  Synchronization is the CALLER's: the
+    paged engine invokes every mutating method under its ``_cond``
+    lock, exactly like the block pool.
+
+    ``dispatch`` is the engine's capture/audit/devicetime wrapper for
+    the load program (``dispatch(name, fn, args, donate_argnums) ->
+    outputs``); ``None`` calls the compiled program directly.
+    """
+
+    def __init__(self, model, arena, store, slots, rank, dispatch=None):
+        c = model.config
+        if getattr(c, "num_experts", 0) > 0:
+            raise ValueError(
+                "adapter serving requires a dense FFN "
+                "(num_experts == 0): the MoE expert matmuls have no "
+                "LoRA epilogue")
+        if int(slots) < 1:
+            raise ValueError(f"adapter_slots must be >= 1, got {slots}")
+        if int(rank) < 1:
+            raise ValueError(f"adapter_rank must be >= 1, got {rank}")
+        self.model = model
+        self.arena = arena
+        self._store = store
+        self._dispatch = dispatch
+        self.slots = int(slots)
+        self.rank = int(rank)
+        self.peek_tokens = ADAPTER_PEEK_TOKENS
+        self._dims = _target_dims(c)
+        self._dt = jnp.dtype(c.dtype)
+        L, R, rows = c.num_layers, self.rank, self.slots + 1
+        self._names = []
+        for t in ADAPTER_TARGETS:
+            di, do = self._dims[t]
+            # replicated on purpose (spec=None): low-rank slabs are tiny
+            # next to the base weights, and replication keeps the
+            # per-row gather free of cross-chip transfers
+            self.arena.declare("adapter_a_" + t,
+                               jnp.zeros((L, rows, di, R), self._dt))
+            self.arena.declare("adapter_b_" + t,
+                               jnp.zeros((L, rows, R, do), self._dt))
+            self._names += ["adapter_a_" + t, "adapter_b_" + t]
+        self._registry = {}            # tenant -> padded host factors
+        self._resident = OrderedDict()  # tenant -> slot, LRU order
+        self._refs = {}                # tenant -> live request count
+        # LIFO free list, lowest slot ids handed out first (determinism;
+        # mirrors BlockPool)
+        self._free = list(range(rows - 1, 0, -1))
+        self._load_jit = None
+        # per-arena monotonic event counts (the fleet sums these across
+        # replicas; the same events feed the global counters registry)
+        self.hits = 0
+        self.misses = 0
+        self.loads = 0
+        self.evictions = 0
+        self.exhausted_events = 0
+        self.load_drops = 0
+        counters.set_gauge("serving.adapter.arena_bytes",
+                           self.device_bytes())
+
+    # -- host registry -------------------------------------------------------
+    def _pad(self, tenant, factors):
+        """Validate + rank-pad one tenant's factor dict.  Accepts a flat
+        ``{"a_<t>": [L, d_in, r], "b_<t>": [L, r, d_out]}`` with any
+        subset of targets (a missing pair leaves that matmul un-adapted
+        — its slab rows stay zero); zero-padding ``r -> R`` on the
+        contracted rank axis is exact."""
+        c = self.model.config
+        L, R = c.num_layers, self.rank
+        known = {f"{p}_{t}" for t in ADAPTER_TARGETS for p in "ab"}
+        extra = set(factors) - known
+        if extra:
+            raise ValueError(
+                f"adapter {tenant!r}: unknown factor keys {sorted(extra)}")
+        out = {}
+        for t in ADAPTER_TARGETS:
+            di, do = self._dims[t]
+            a, b = factors.get("a_" + t), factors.get("b_" + t)
+            if (a is None) != (b is None):
+                raise ValueError(
+                    f"adapter {tenant!r}: target {t!r} needs both "
+                    f"a_{t} and b_{t}")
+            if a is None:
+                out["a_" + t] = np.zeros((L, di, R), self._dt)
+                out["b_" + t] = np.zeros((L, R, do), self._dt)
+                continue
+            a = np.asarray(a)
+            b = np.asarray(b)
+            r = a.shape[-1] if a.ndim == 3 else -1
+            if a.shape != (L, di, r) or b.shape != (L, r, do) \
+                    or not 1 <= r <= R:
+                raise ValueError(
+                    f"adapter {tenant!r}: target {t!r} expects "
+                    f"a [L={L}, {di}, r<= {R}] and b [L, r, {do}], got "
+                    f"{a.shape} / {b.shape}")
+            ap = np.zeros((L, di, R), self._dt)
+            bp = np.zeros((L, R, do), self._dt)
+            ap[:, :, :r] = a
+            bp[:, :r, :] = b
+            out["a_" + t] = ap
+            out["b_" + t] = bp
+        return out
+
+    def register(self, tenant, factors):
+        """Install (or replace) one tenant's host-side factors.  A
+        resident-but-idle tenant is evicted so the next acquire pages in
+        the new weights; replacing a tenant a running request still
+        references is refused — it would swap the model under the
+        request mid-stream."""
+        if tenant is None or tenant == 0:
+            raise ValueError("tenant id None/0 is the base model")
+        if self._refs.get(tenant, 0) > 0:
+            raise ValueError(
+                f"adapter {tenant!r} is referenced by "
+                f"{self._refs[tenant]} running request(s); drain before "
+                "re-registering")
+        padded = self._pad(tenant, factors)
+        slot = self._resident.pop(tenant, None)
+        if slot is not None:
+            self._refs.pop(tenant, None)
+            self._free.append(slot)
+            counters.set_gauge("serving.adapter.resident",
+                               len(self._resident))
+        self._registry[tenant] = padded
+
+    @property
+    def registered(self):
+        return len(self._registry)
+
+    def export_registry(self):
+        """The padded host factors, for fleet respawn replay."""
+        return dict(self._registry)
+
+    # -- slot lifecycle ------------------------------------------------------
+    def _take_slot(self):
+        if self._free:
+            return self._free.pop()
+        victim = next((t for t, s in self._resident.items()
+                       if self._refs.get(t, 0) == 0), None)
+        if victim is None:
+            return None
+        slot = self._resident.pop(victim)
+        self._refs.pop(victim, None)
+        self.evictions += 1
+        counters.inc("serving.adapter.evictions")
+        counters.set_gauge("serving.adapter.resident",
+                           len(self._resident))
+        return slot
+
+    def acquire(self, tenant, rid=None):
+        """Pin ``tenant``'s factors for one request; returns its slot id
+        (the row the request's ``adapter_ids`` operand carries).
+        ``tenant None`` is the base model: slot 0, never refcounted.
+        Raises :class:`AdapterArenaExhausted` (nothing allocated, no
+        refcount moved) when the arena cannot host the tenant, and
+        ``KeyError`` for an unregistered tenant."""
+        if tenant is None:
+            return 0
+        factors = self._registry.get(tenant)
+        if factors is None:
+            raise KeyError(f"adapter {tenant!r} is not registered")
+        slot = self._resident.get(tenant)
+        if slot is not None:
+            self._refs[tenant] = self._refs.get(tenant, 0) + 1
+            self._resident.move_to_end(tenant)
+            self.hits += 1
+            counters.inc("serving.adapter.hits")
+            return slot
+        self.misses += 1
+        counters.inc("serving.adapter.misses")
+        slot = self._take_slot()
+        if slot is None:
+            self.exhausted_events += 1
+            counters.inc("serving.adapter.arena_exhausted")
+            raise AdapterArenaExhausted(
+                f"adapter arena full: all {self.slots} slots referenced",
+                needed=1, free=0)
+        if _fi.take("adapter_load_drop", rid):
+            # injected page-in failure BEFORE any slab write: hand the
+            # slot back untouched — the request degrades to queued-with-
+            # backoff and can never see another tenant's weights
+            self._free.append(slot)
+            self.load_drops += 1
+            counters.inc("serving.adapter.load_drops")
+            raise AdapterArenaExhausted(
+                f"injected adapter_load_drop for tenant {tenant!r}",
+                needed=1, free=len(self._free))
+        self._load(slot, factors)
+        self._resident[tenant] = slot
+        self._refs[tenant] = 1
+        self.loads += 1
+        counters.inc("serving.adapter.loads")
+        counters.set_gauge("serving.adapter.resident",
+                           len(self._resident))
+        return slot
+
+    def release(self, tenant):
+        """Drop one request's reference; the tenant stays resident (an
+        LRU eviction candidate at refcount 0) so a follow-up request
+        reuses the warm slot."""
+        if tenant is None:
+            return
+        r = self._refs.get(tenant, 0)
+        if r <= 0:
+            raise ValueError(
+                f"release of unreferenced adapter {tenant!r}")
+        self._refs[tenant] = r - 1
+
+    # -- device load ---------------------------------------------------------
+    def _loader(self):
+        if self._load_jit is None:
+            names = tuple(f"{p}_{t}" for t in ADAPTER_TARGETS
+                          for p in "ab")
+
+            def build():
+                def load(slabs, factors, slot):
+                    counters.inc("serving.retraces")  # trace-time only
+                    return {n: slabs[n].at[:, slot].set(factors[n])
+                            for n in names}
+                return jax.jit(load, donate_argnums=(0,))
+            self._load_jit = self.arena.program(
+                self._store, self.arena.decorate("adapter_load"), build)
+        return self._load_jit
+
+    def _load(self, slot, factors):
+        """Page one tenant's factors into ``slot``: ONE fixed-shape
+        donated dispatch (slot + factors are operands, so every load
+        reuses the same compiled program)."""
+        fn = self._loader()
+        slabs = {n.replace("adapter_", "", 1): self.arena.get(n)
+                 for n in self._names}
+        ops = {n: self.arena.operand(v) for n, v in factors.items()}
+        args = (slabs, ops, np.int32(slot))
+        if self._dispatch is not None:
+            out = self._dispatch("serving.adapter.load", fn, args, (0,))
+        else:
+            out = fn(*args)
+        for n, v in out.items():
+            self.arena.bind("adapter_" + n, v)
+
+    # -- dispatch / routing views -------------------------------------------
+    def slabs(self):
+        """The live slab dict for a model dispatch (read-only — decode/
+        prefill/verify never donate it), keyed as ``gpt._mm_lora``
+        expects: ``a_<t>`` / ``b_<t>``."""
+        return {n.replace("adapter_", "", 1): self.arena.get(n)
+                for n in self._names}
+
+    def peek(self, tenant):
+        """Router cost-model bonus: ``peek_tokens`` when the tenant is
+        already resident here (dispatching to this replica skips a cold
+        page-in), else 0."""
+        if tenant is None or tenant not in self._resident:
+            return 0
+        return self.peek_tokens
+
+    def device_bytes(self):
+        return self.arena.device_bytes(*self._names)
+
+    def release_slabs(self):
+        for n in self._names:
+            self.arena.bind(n, None)
+
+    def stats(self):
+        return {
+            "slots": self.slots,
+            "rank": self.rank,
+            "resident": len(self._resident),
+            "registered": len(self._registry),
+            "tenants": {t: self._refs.get(t, 0) for t in self._resident},
+            "loads": self.loads,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "exhausted": self.exhausted_events,
+            "load_drops": self.load_drops,
+            "arena_bytes": self.device_bytes(),
+        }

@@ -37,6 +37,7 @@ import threading
 import time
 import warnings
 import weakref
+import zlib
 from collections import deque
 
 import jax
@@ -107,7 +108,7 @@ class Request:
                  "temperature", "top_k", "top_p", "eos_token_id", "seed",
                  "state", "finish_reason", "tokens", "slot", "arrival_ns",
                  "last_emit_ns", "deadline", "_cancel", "_engine", "error",
-                 "tag", "trace", "hold")
+                 "tag", "trace", "hold", "adapter")
 
     def __init__(self, rid, prompt, max_new_tokens, do_sample, temperature,
                  top_k, top_p, eos_token_id, seed, deadline, engine):
@@ -134,6 +135,7 @@ class Request:
         self.tag = None           # opaque owner backref (fleet router)
         self.trace = None         # TraceContext when request tracing is on
         self.hold = False         # park after prefill for KV migration
+        self.adapter = None       # tenant id (LoRA adapter), None = base
 
     @property
     def is_finished(self):
@@ -209,7 +211,8 @@ class LLMEngine:
                  block_size=16, n_blocks=None, prefill_chunk=None,
                  prefix_cache=True, kv_dtype=None, weight_dtype=None,
                  host_kv_blocks=0, spill_idle_steps=0, mesh=None,
-                 shard_rules=None):
+                 shard_rules=None, adapter_slots=0, adapter_rank=8,
+                 tenant_buckets=8):
         if kv_layout not in ("slots", "paged"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}; "
                              "want 'slots' or 'paged'")
@@ -222,7 +225,17 @@ class LLMEngine:
         if weight_dtype not in (None, "int8"):
             raise ValueError(f"weight_dtype must be None or 'int8', "
                              f"got {weight_dtype!r}")
+        if int(adapter_slots or 0) > 0 and kv_layout != "paged":
+            raise ValueError("adapter_slots requires kv_layout='paged' "
+                             "(adapter ids ride the paged dispatches)")
         self.kv_layout = kv_layout
+        # multi-tenant LoRA knobs (paged engine only; 0 disables).
+        # tenant_buckets bounds the per-tenant telemetry cardinality:
+        # TTFT/ITL histograms are keyed by a stable hash bucket, never by
+        # raw tenant id.
+        self.adapter_slots = int(adapter_slots or 0)
+        self.adapter_rank = int(adapter_rank)
+        self.tenant_buckets = int(tenant_buckets)
         # paged-arena knobs (used by the PagedLLMEngine _init_kv override;
         # inert under the default slot layout)
         self.block_size = int(block_size)
@@ -308,6 +321,26 @@ class LLMEngine:
         metrics.observe(name, value, sum_counter=sum_counter,
                         extra=self.hists[name])
 
+    def _tenant_bucket(self, tenant):
+        """Stable low-cardinality label for per-tenant isolation
+        telemetry: ``"base"`` for un-adapted rows, else a crc32 hash
+        bucket so thousands of tenants fold into ``tenant_buckets``
+        histogram keys."""
+        if tenant is None:
+            return "base"
+        return f"t{zlib.crc32(str(tenant).encode()) % self.tenant_buckets}"
+
+    def _observe_tenant(self, base, tenant, value):
+        """Record a latency sample into the tenant-bucketed histogram
+        (created lazily — only buckets that actually serve traffic
+        exist).  Feeds the global registry too, so the health plane's
+        ``noisy_neighbor`` watchdog sees the same windows."""
+        name = f"{base}.tenant.{self._tenant_bucket(tenant)}"
+        h = self.hists.get(name)
+        if h is None:
+            h = self.hists[name] = metrics.Histogram(name, "ns")
+        metrics.observe(name, value, extra=h)
+
     def _maybe_capture(self, name, fn, *args):
         """Record HBM/compile/FLOPs stats for a compiled program, once per
         program name (gated by FLAGS_device_telemetry; the AOT lower costs
@@ -378,19 +411,29 @@ class LLMEngine:
         — the fleet frees its HBM before respawning)."""
         self._ck = self._cv = None
 
-    def prefix_peek(self, prompt):
+    def prefix_peek(self, prompt, tenant=None):
         """Tokens of ``prompt`` a prefix cache could serve without
         prefilling — 0 under the slot layout (no sharing), overridden by
         the paged engine.  The Router uses this for prefix-hit-aware
-        dispatch."""
+        dispatch.  ``tenant`` scopes the probe to that adapter's KV
+        plane (KV computed under a LoRA adapter never matches base)."""
         return 0
 
-    def prefix_probe(self, prompt):
+    def prefix_probe(self, prompt, tenant=None):
         """``(device_tokens, host_tokens)`` a prefix cache could serve —
         ``(0, 0)`` under the slot layout; the paged engine overrides.
         The Router's cost model discounts the host component by the
-        restore price (see ``serving.router``)."""
+        restore price (see ``serving.router``).  ``tenant`` scopes the
+        probe to that adapter's KV plane."""
         return 0, 0
+
+    def adapter_peek(self, tenant):
+        """Tokens of prefill-equivalent work saved because ``tenant``'s
+        LoRA factors are already resident in this replica's adapter
+        arena — 0 here (the slot engine serves no adapters), overridden
+        by the paged engine.  The Router folds this into the same cost
+        model as ``prefix_peek`` for tenant-affine dispatch."""
+        return 0
 
     # -- compiled programs ---------------------------------------------------
     @staticmethod
@@ -488,7 +531,7 @@ class LLMEngine:
     def add_request(self, prompt, max_new_tokens=32, do_sample=False,
                     temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                     seed=None, deadline_s=None, block=True, timeout=None,
-                    trace_ctx=None, hold_after_prefill=False):
+                    trace_ctx=None, hold_after_prefill=False, adapter=None):
         """Enqueue one prompt; returns the live ``Request`` handle.
 
         Backpressure: when the bounded queue is full, ``block=False``
@@ -505,9 +548,15 @@ class LLMEngine:
         entering decode, emitting a ``{"type": "prefilled"}`` event — the
         disaggregated fleet's hand-off point for KV migration to a decode
         replica.  Honored by the paged engine; slot-layout engines decode
-        in place (there is no block table to migrate)."""
+        in place (there is no block table to migrate).  ``adapter`` names
+        the tenant whose registered LoRA factors decorate this request's
+        matmuls (None = base model); requires an engine built with
+        ``adapter_slots > 0``."""
         if self._closed:
             raise EngineClosed("engine is drained; no new requests")
+        if adapter is not None and not self.adapter_slots:
+            raise ValueError("adapter given but the engine was built "
+                             "with adapter_slots=0")
         ids = np.asarray(
             prompt._data if hasattr(prompt, "_data") else prompt,
             dtype=np.int32).reshape(-1)
@@ -528,6 +577,7 @@ class LLMEngine:
                       float(top_p), (None if eos is None else int(eos)),
                       int(seed), deadline, self)
         req.hold = bool(hold_after_prefill)
+        req.adapter = adapter
         req.trace = trace_ctx if trace_ctx is not None \
             else rtrace.new_trace(req.rid)
         if req.trace is not None:
@@ -652,8 +702,14 @@ class LLMEngine:
         now_ns = time.monotonic_ns()
         if len(req.tokens) == 1:
             self._observe("serving.ttft_ns", now_ns - req.arrival_ns)
+            if self.adapter_slots:
+                self._observe_tenant("serving.ttft_ns", req.adapter,
+                                     now_ns - req.arrival_ns)
         elif req.last_emit_ns is not None:
             self._observe("serving.itl_ns", now_ns - req.last_emit_ns)
+            if self.adapter_slots:
+                self._observe_tenant("serving.itl_ns", req.adapter,
+                                     now_ns - req.last_emit_ns)
         req.last_emit_ns = now_ns
         with self._cond:
             self._outstanding -= 1

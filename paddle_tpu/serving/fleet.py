@@ -270,7 +270,8 @@ class ServingFleet:
                  weight_dtype=None, draft_model=None, spec_k=4,
                  prefill_replicas=0, autoscale=False, autoscale_kw=None,
                  health_kw=None, host_kv_blocks=0, spill_idle_steps=0,
-                 restore_cost=0.5, mesh=None, shard_rules=None):
+                 restore_cost=0.5, mesh=None, shard_rules=None,
+                 adapter_slots=0, adapter_rank=8):
         self.model = model
         prefill_replicas = int(prefill_replicas)
         if prefill_replicas:
@@ -306,6 +307,13 @@ class ServingFleet:
             # through the per-model program registry
             self._engine_kw.update(draft_model=draft_model,
                                    spec_k=spec_k)
+        if int(adapter_slots or 0) > 0:
+            # every replica hosts a multi-tenant LoRA adapter arena; the
+            # fleet-level registry below replays tenant registrations
+            # into respawned replicas
+            self._engine_kw.update(adapter_slots=int(adapter_slots),
+                                   adapter_rank=int(adapter_rank))
+        self._adapter_reg = {}   # tenant -> factors (respawn replay)
         self.router = (router if router is not None
                        else Router(slo_margin, restore_cost=restore_cost))
         # the health plane: construction is free; every tick is gated on
@@ -366,9 +374,33 @@ class ServingFleet:
         rep = Replica(next(self._idx), LLMEngine(self.model,
                                                  **self._engine_kw),
                       role=role)
+        self._replay_adapters(rep)
         self._warm(rep)
         self._install(rep)
         return rep
+
+    def _replay_adapters(self, rep):
+        """Re-register every fleet-known tenant on a (re)spawned replica
+        BEFORE it joins dispatch, so a retry routed there never sees an
+        unregistered tenant."""
+        if not self._adapter_reg:
+            return
+        with self._lock:
+            items = list(self._adapter_reg.items())
+        for tenant, factors in items:
+            rep.engine.register_adapter(tenant, factors)
+
+    def register_adapter(self, tenant, factors):
+        """Install one tenant's LoRA factors fleet-wide: staged in the
+        fleet registry (respawn replay) and registered on every live
+        replica, so routing is free to place the tenant anywhere."""
+        if not self._engine_kw.get("adapter_slots"):
+            raise ValueError("fleet was built with adapter_slots=0")
+        with self._lock:
+            self._adapter_reg[tenant] = factors
+            reps = [r for r in self._replicas if r.alive]
+        for rep in reps:
+            rep.engine.register_adapter(tenant, factors)
 
     def _has_role(self, role):
         with self._lock:
@@ -546,12 +578,15 @@ class ServingFleet:
     # -- dispatch ------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, do_sample=False,
                temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-               seed=None, deadline_s=None):
+               seed=None, deadline_s=None, adapter=None):
         """Route one prompt onto the least-loaded replica; returns the
         stable :class:`FleetRequest` handle.  Raises :class:`RetryAfter`
         (with ``queue_depth`` + ``retry_after_hint``) when admission is
         shed — deadline budget already blown by the estimated queue
-        delay — or every replica queue is full."""
+        delay — or every replica queue is full.  ``adapter`` names a
+        fleet-registered tenant (see :meth:`register_adapter`); the
+        router's cost model prefers replicas whose arena already holds
+        the tenant's factors, and the tenant rides every retry."""
         if self._closed:
             raise EngineClosed("fleet is drained; no new requests")
         ids = np.asarray(
@@ -573,6 +608,12 @@ class ServingFleet:
                   do_sample=bool(do_sample), temperature=float(temperature),
                   top_k=int(top_k), top_p=float(top_p),
                   eos_token_id=eos_token_id)
+        if adapter is not None:
+            if adapter not in self._adapter_reg:
+                raise KeyError(f"adapter {adapter!r} is not registered "
+                               "on this fleet (register_adapter first)")
+            # riding kw means every retry/redispatch carries the tenant
+            kw["adapter"] = adapter
         freq = FleetRequest(rid, ids, kw, int(seed), deadline_s)
         freq.trace = rtrace.new_trace(rid)
         est = int(ids.shape[0]) + int(max_new_tokens)
@@ -583,7 +624,8 @@ class ServingFleet:
             rep = self.router.pick(
                 self._candidates(), est_tokens=est,
                 deadline_s=deadline_s, prompt=ids,
-                role="prefill" if self._has_role("prefill") else None)
+                role="prefill" if self._has_role("prefill") else None,
+                adapter=adapter)
         except RetryAfter:
             if freq.trace is not None:
                 rtrace.finish(freq.trace, "shed")
@@ -615,7 +657,8 @@ class ServingFleet:
                 est_tokens=freq.kw["max_new_tokens"] - len(freq.tokens),
                 shed=False,    # requeues were admitted: never shed
                 prompt=freq.prompt,
-                role="prefill" if self._has_role("prefill") else None)
+                role="prefill" if self._has_role("prefill") else None,
+                adapter=freq.kw.get("adapter"))
         left = None
         if freq.deadline is not None:
             left = max(0.0, freq.deadline - time.monotonic())
@@ -1135,6 +1178,38 @@ class ServingFleet:
                                     for st in paged),
                 "tier_restored": sum(st.get("tier_restored", 0)
                                      for st in paged),
+            }
+        adapted = [st for st in reps
+                   if st.get("adapters") is not None and st["alive"]]
+        if adapted:
+            # fleet-wide adapter-arena roll-up: summed monotonic event
+            # counts plus the merged per-tenant occupancy (which tenants
+            # are resident where, with how many live references)
+            tenants = {}
+            for st in adapted:
+                for t, refs in st["adapters"]["tenants"].items():
+                    ent = tenants.setdefault(t, {"replicas": 0, "refs": 0})
+                    ent["replicas"] += 1
+                    ent["refs"] += refs
+            out["adapters"] = {
+                "slots": sum(st["adapters"]["slots"] for st in adapted),
+                "resident": sum(st["adapters"]["resident"]
+                                for st in adapted),
+                "registered": max(st["adapters"]["registered"]
+                                  for st in adapted),
+                "loads": sum(st["adapters"]["loads"] for st in adapted),
+                "hits": sum(st["adapters"]["hits"] for st in adapted),
+                "misses": sum(st["adapters"]["misses"] for st in adapted),
+                "evictions": sum(st["adapters"]["evictions"]
+                                 for st in adapted),
+                "exhausted": sum(st["adapters"]["exhausted"]
+                                 for st in adapted),
+                "load_drops": sum(st["adapters"]["load_drops"]
+                                  for st in adapted),
+                "arena_bytes": sum(st["adapters"]["arena_bytes"]
+                                   for st in adapted),
+                "routed": counters.get("serving.fleet.adapter_routed"),
+                "tenants": tenants,
             }
         spec = [st for st in reps
                 if st.get("speculative") and st["alive"]]

@@ -47,6 +47,7 @@ token-identical to the slot engine and to sequential ``generate``.
 from __future__ import annotations
 
 import time
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -168,6 +169,20 @@ class PagedLLMEngine(LLMEngine):
         self._host_spec = tuple(spec)
         self._req_host = {}    # rid -> {"idx": set[int], "lost": bool}
         self._held_idle = {}   # rid -> idle scheduler steps while held
+        # multi-tenant LoRA adapter arena (adapter_slots=0 disables; the
+        # slabs are declared through the same StateArena as the KV pools
+        # so they inherit the donation/compile-cache protocol)
+        if self.adapter_slots > 0:
+            from .adapters import AdapterArena
+            self.adapters = AdapterArena(
+                self.model, self.arena, _model_programs(self.model),
+                self.adapter_slots, self.adapter_rank,
+                dispatch=self._adapter_dispatch)
+        else:
+            self.adapters = None
+        # per-slot adapter arena row (host mirror; rides every dispatch
+        # as an int32 operand — row 0 = base model)
+        self._aid = np.zeros(B, np.int32)
         # per-engine prefix-cache accounting (the fleet sums these; the
         # same events also feed the process-global counters registry)
         self.kv_prefix_hits = 0
@@ -218,29 +233,78 @@ class PagedLLMEngine(LLMEngine):
 
     def release_kv(self):
         self._pk = self._pv = self._sk = self._sv = None
+        if self.adapters is not None:
+            self.adapters.release_slabs()
 
-    def prefix_peek(self, prompt):
+    def _adapter_dispatch(self, name, fn, args, dn):
+        """Capture/audit/devicetime bracket for the adapter arena's load
+        program — the same discipline every other engine dispatch gets,
+        handed to the arena as a callback so it never reaches into
+        engine internals."""
+        self._maybe_capture(name, fn, *args)
+        self._maybe_audit(name, fn, *args, donate_argnums=dn)
+        _dt = _devicetime.note(name)
+        out = fn(*args)
+        _devicetime.observe(_dt, out)
+        return out
+
+    def register_adapter(self, tenant, factors):
+        """Stage ``tenant``'s LoRA factors host-side (see
+        :meth:`AdapterArena.register`); they page into the device arena
+        on the tenant's first admission."""
+        if self.adapters is None:
+            raise ValueError("engine was built with adapter_slots=0")
+        with self._cond:
+            self.adapters.register(tenant, factors)
+
+    def adapter_peek(self, tenant):
+        if self.adapters is None or tenant is None:
+            return 0
+        with self._cond:
+            return self.adapters.peek(tenant)
+
+    @staticmethod
+    def _prefix_key(tokens, tenant):
+        """Tenant-salted token stream for the prefix tree.  KV computed
+        under a LoRA adapter is NOT interchangeable with base-model KV
+        for the same tokens (the adapter perturbs the QKV projection),
+        so each tenant's cached prefixes live in a disjoint key plane:
+        tokens are offset by a per-tenant constant above the vocab range
+        (block alignment preserved, base traffic stays unsalted — its
+        tree behavior is bit-identical to the adapter-free engine)."""
+        if tenant is None:
+            return tokens
+        salt = (zlib.crc32(str(tenant).encode("utf-8")) + 1) << 32
+        return [t + salt for t in tokens]
+
+    def prefix_peek(self, prompt, tenant=None):
         if self.prefix is None:
             return 0
         ids = np.asarray(
             prompt._data if hasattr(prompt, "_data") else prompt,
             dtype=np.int32).reshape(-1)
         with self._cond:
-            return self.prefix.peek(ids.tolist(), int(ids.shape[0]) - 1)
+            return self.prefix.peek(
+                self._prefix_key(ids.tolist(), tenant),
+                int(ids.shape[0]) - 1)
 
-    def prefix_probe(self, prompt):
+    def prefix_probe(self, prompt, tenant=None):
         """``(device_tokens, host_tokens)`` the prefix cache could serve
         for this prompt — the router's restore-aware dispatch score
         (device hits are free; host hits pay a page-in first, so the
         cost model discounts them).  Cheap on misses: the radix digest
-        short-circuits the walk (see ``PrefixCache.probe``)."""
+        short-circuits the walk (see ``PrefixCache.probe``).  ``tenant``
+        scopes the probe to that adapter's KV plane (see
+        :meth:`_prefix_key`)."""
         if self.prefix is None:
             return 0, 0
         ids = np.asarray(
             prompt._data if hasattr(prompt, "_data") else prompt,
             dtype=np.int32).reshape(-1)
         with self._cond:
-            return self.prefix.probe(ids.tolist(), int(ids.shape[0]) - 1)
+            return self.prefix.probe(
+                self._prefix_key(ids.tolist(), tenant),
+                int(ids.shape[0]) - 1)
 
     # -- compiled programs ---------------------------------------------------
     # The jitted callables live in the per-model cache shared by every
@@ -254,11 +318,17 @@ class PagedLLMEngine(LLMEngine):
     # The arena tag (e.g. "[mp2]") rides the key AND the display name so
     # a sharded program can never serve an unsharded engine, and ledger /
     # capture rows stay distinguishable per mesh shape.
+    # An adapter-enabled engine's programs take two extra operands (the
+    # slab pytree + per-row ids), so they key separately — an
+    # adapter-free engine keys exactly as before and shares nothing with
+    # an adapter engine over the same model.
     def _prog_key(self, base):
+        lo = (f"+lora{self.adapter_rank}"
+              if getattr(self, "adapters", None) is not None else "")
         if self.kv_kernel == "off" and self.kv_dtype is None:
-            return base + self.arena.tag
+            return base + lo + self.arena.tag
         return (f"{base}@{self.kv_kernel}:{self.kv_dtype or 'raw'}"
-                f"{self.arena.tag}")
+                f"{lo}{self.arena.tag}")
 
     def _pchunk_for(self, bucket):
         fn = self._pchunk_jits.get(bucket)
@@ -266,12 +336,20 @@ class PagedLLMEngine(LLMEngine):
             model = self.model
 
             def build():
+                # adapter engines append the slab pytree + per-row ids as
+                # trailing operands (never donated — the gather reads
+                # them); donation indices are untouched
+                lora = self.adapters is not None
+
                 if self.kv_dtype:
                     def pchunk(w, ids, start, length, bt, pk, pv, sk, sv,
-                               key_data, do_sample, temp, top_k, top_p):
+                               key_data, do_sample, temp, top_k, top_p,
+                               *ad):
                         counters.inc("serving.retraces")  # trace-time only
+                        aw, aid = ad if lora else (None, None)
                         pk, pv, sk, sv, logits = model.prefill_paged(
-                            w, ids, start, length, bt, pk, pv, sk, sv)
+                            w, ids, start, length, bt, pk, pv, sk, sv,
+                            adapters=aw, adapter_ids=aid)
                         tok, new_key = LLMEngine._first_token(
                             logits, jax.random.wrap_key_data(key_data),
                             do_sample, temp, top_k, top_p)
@@ -279,10 +357,12 @@ class PagedLLMEngine(LLMEngine):
                     return jax.jit(pchunk, donate_argnums=(5, 6, 7, 8))
 
                 def pchunk(w, ids, start, length, bt, pk, pv, key_data,
-                           do_sample, temp, top_k, top_p):
+                           do_sample, temp, top_k, top_p, *ad):
                     counters.inc("serving.retraces")  # trace-time only
+                    aw, aid = ad if lora else (None, None)
                     pk, pv, logits = model.prefill_paged(
-                        w, ids, start, length, bt, pk, pv)
+                        w, ids, start, length, bt, pk, pv,
+                        adapters=aw, adapter_ids=aid)
                     tok, new_key = LLMEngine._first_token(
                         logits, jax.random.wrap_key_data(key_data),
                         do_sample, temp, top_k, top_p)
@@ -326,13 +406,17 @@ class PagedLLMEngine(LLMEngine):
                                     greedy).astype(jnp.int32)
                     return nxt, jax.random.key_data(new_keys)
 
+                lora = self.adapters is not None
+
                 if self.kv_dtype:
                     def decode(w, pk, pv, sk, sv, bt, tok, pos, keys_data,
-                               do_sample, temp, top_k, top_p):
+                               do_sample, temp, top_k, top_p, *ad):
                         counters.inc("serving.retraces")
+                        aw, aid = ad if lora else (None, None)
                         logits, pk, pv, sk, sv = model.decode_paged(
                             w, tok, pos, bt, pk, pv, sk, sv, kernel=mode,
-                            mesh=mesh, head_axis=head_axis)
+                            mesh=mesh, head_axis=head_axis,
+                            adapters=aw, adapter_ids=aid)
                         nxt, new_keys = sample_next(
                             logits, keys_data, do_sample, temp, top_k,
                             top_p)
@@ -340,11 +424,13 @@ class PagedLLMEngine(LLMEngine):
                     return jax.jit(decode, donate_argnums=(1, 2, 3, 4))
 
                 def decode(w, pk, pv, bt, tok, pos, keys_data,
-                           do_sample, temp, top_k, top_p):
+                           do_sample, temp, top_k, top_p, *ad):
                     counters.inc("serving.retraces")
+                    aw, aid = ad if lora else (None, None)
                     logits, pk, pv = model.decode_paged(
                         w, tok, pos, bt, pk, pv, kernel=mode,
-                        mesh=mesh, head_axis=head_axis)
+                        mesh=mesh, head_axis=head_axis,
+                        adapters=aw, adapter_ids=aid)
                     nxt, new_keys = sample_next(
                         logits, keys_data, do_sample, temp, top_k,
                         top_p)
@@ -752,6 +838,19 @@ class PagedLLMEngine(LLMEngine):
 
     # -- request intake ------------------------------------------------------
     def add_request(self, prompt, max_new_tokens=32, **kw):
+        tenant = kw.get("adapter")
+        if tenant is not None:
+            # refuse unregistered tenants HERE, synchronously — admission
+            # runs on the scheduler thread, where a KeyError would
+            # poison the whole step, not just this request
+            if self.adapters is None:
+                raise ValueError("adapter given but the engine was "
+                                 "built with adapter_slots=0")
+            with self._cond:
+                if tenant not in self.adapters._registry:
+                    raise KeyError(
+                        f"adapter {tenant!r} is not registered on this "
+                        "engine (register_adapter first)")
         ids = np.asarray(
             prompt._data if hasattr(prompt, "_data") else prompt,
             dtype=np.int32).reshape(-1)
@@ -781,15 +880,30 @@ class PagedLLMEngine(LLMEngine):
         t0_tr = time.perf_counter_ns() if tr is not None else 0
         with self._cond:
             injected = _fi.take("kv_pool_exhausted", req.rid)
+            aslot = 0
+            if self.adapters is not None and req.adapter is not None:
+                # pin the tenant's LoRA slot FIRST (a cold tenant pages
+                # in here, one bounded donated dispatch — part of the
+                # atomic reservation like the COW adopt below); a full
+                # arena or an injected adapter_load_drop defers the
+                # request exactly like KV exhaustion, nothing allocated
+                from .adapters import AdapterArenaExhausted
+                try:
+                    aslot = self.adapters.acquire(req.adapter,
+                                                  rid=req.rid)
+                except AdapterArenaExhausted as e:
+                    flight.record("serving.adapter.exhausted",
+                                  rid=req.rid, tenant=str(req.adapter),
+                                  needed=e.needed, free=e.free)
+                    return False
             shared, cached, pnode, p = [], 0, None, 0
             if self.prefix is not None and not injected:
+                pkey = self._prefix_key(req.prompt.tolist(), req.adapter)
                 if self._host_tier is not None:
                     # page host-resident prefix blocks back in first so
                     # the match below adopts them like any cached prefix
-                    self._restore_prefix(req.prompt.tolist(), T - 1,
-                                         req.rid)
-                shared, cached, pnode, p = self.prefix.match(
-                    req.prompt.tolist(), T - 1)
+                    self._restore_prefix(pkey, T - 1, req.rid)
+                shared, cached, pnode, p = self.prefix.match(pkey, T - 1)
             fresh_needed = total - len(shared)
             shortfall = fresh_needed - self.pool.free_blocks
             if shortfall > 0 and self.prefix is not None:
@@ -806,6 +920,10 @@ class PagedLLMEngine(LLMEngine):
                     self.pool.release(b)
                 if pnode is not None:
                     self.pool.release(pnode.block)
+                if aslot:
+                    # unwind the adapter pin; the tenant stays resident
+                    # at refcount 0 so the retry re-acquires it warm
+                    self.adapters.release(req.adapter)
                 self.kv_pool_exhausted_events += 1
                 counters.inc("serving.kv.pool_exhausted")
                 flight.record("serving.kv.pool_exhausted", rid=req.rid,
@@ -867,6 +985,7 @@ class PagedLLMEngine(LLMEngine):
             self._slot_blocks[slot] = table
             self._bt[slot] = 0
             self._bt[slot, :len(table)] = table
+            self._aid[slot] = aslot
             self._running[slot] = False
             req.state = "prefilling"
             req.slot = slot
@@ -933,6 +1052,11 @@ class PagedLLMEngine(LLMEngine):
             tail = (key_data, np.bool_(req.do_sample),
                     np.float32(req.temperature), np.int32(req.top_k),
                     np.float32(req.top_p))
+            if self.adapters is not None:
+                # slab pytree + this request's arena row ([1]-shaped to
+                # match the chunk's batch) as trailing operands
+                tail = tail + (self.adapters.slabs(), self.arena.operand(
+                    np.asarray([self._aid[slot]], np.int32)))
             if self.kv_dtype:
                 pargs = (*head, self._pk, self._pv, self._sk, self._sv,
                          *tail)
@@ -1031,6 +1155,12 @@ class PagedLLMEngine(LLMEngine):
                     op(pos_eff), op(self._keys),
                     op(self._dosample), op(self._temp),
                     op(self._topk), op(self._topp))
+            if self.adapters is not None:
+                # non-running rows decode against the base row (id 0) —
+                # same trick as the trash-block tabling above
+                aid_eff = np.where(self._running, self._aid,
+                                   0).astype(np.int32)
+                tail = tail + (self.adapters.slabs(), op(aid_eff))
             if self.kv_dtype:
                 dargs = (self._w, self._pk, self._pv, self._sk, self._sv,
                          *tail)
@@ -1106,6 +1236,7 @@ class PagedLLMEngine(LLMEngine):
                 "table": list(self._slot_blocks[slot]),
                 "block_size": self.pool.block_size,
                 "kv_dtype": self.kv_dtype,
+                "adapter": req.adapter,
             }
 
     def adopt_migration(self, mig, src, trace_ctx=None):
@@ -1150,19 +1281,41 @@ class PagedLLMEngine(LLMEngine):
                     "no free decode slot for migration",
                     queue_depth=len(self._queue),
                     retry_after_hint=self._retry_hint_locked())
+            mig_ad = mig.get("adapter")
+            aslot = 0
+            if mig_ad is not None:
+                # the destination re-acquires by tenant name against its
+                # OWN arena/registry — adapter factors never ride the
+                # migration payload.  A full arena (or an engine without
+                # adapters) refuses with nothing allocated; the fleet
+                # replays by deterministic re-prefill.
+                from .adapters import AdapterArenaExhausted
+                if self.adapters is None:
+                    raise ValueError(
+                        f"migrated request carries adapter {mig_ad!r} "
+                        "but this engine was built with adapter_slots=0")
+                try:
+                    aslot = self.adapters.acquire(mig_ad)
+                except (AdapterArenaExhausted, KeyError) as e:
+                    raise EngineBackpressure(
+                        f"adapter arena cannot host migrated tenant "
+                        f"{mig_ad!r}: {e}",
+                        queue_depth=len(self._queue),
+                        retry_after_hint=self._retry_hint_locked()) \
+                        from e
             shared, cached = [], 0
             if self.prefix is not None:
+                pkey = self._prefix_key(seq.tolist(), mig_ad)
                 if self._host_tier is not None:
                     # a host-resident prefix counts as "held here" for
                     # the router's cost model — page it in so the
                     # match below shares it instead of copying
-                    self._restore_prefix(seq.tolist(), (pos // bs) * bs,
-                                         -1)
+                    self._restore_prefix(pkey, (pos // bs) * bs, -1)
                 # only whole blocks strictly below the write frontier are
                 # shareable: the block holding position ``pos`` will be
                 # written by the next decode step and must stay private
                 shared, cached = self.prefix.match_full(
-                    seq.tolist(), (pos // bs) * bs)
+                    pkey, (pos // bs) * bs)
             n_shared = len(shared)
             fresh_needed = total - n_shared
             shortfall = fresh_needed - self.pool.free_blocks
@@ -1176,6 +1329,8 @@ class PagedLLMEngine(LLMEngine):
             if shortfall > 0:
                 for b in shared:
                     self.pool.release(b)
+                if aslot:
+                    self.adapters.release(mig_ad)
                 self.kv_pool_exhausted_events += 1
                 counters.inc("serving.kv.pool_exhausted")
                 flight.record("serving.kv.pool_exhausted",
@@ -1236,6 +1391,7 @@ class PagedLLMEngine(LLMEngine):
             req.arrival_ns = mig["arrival_ns"]
             req.last_emit_ns = mig["last_emit_ns"]
             req.trace = trace_ctx
+            req.adapter = mig_ad
             req.state = "running"
             slot = self._free.pop()
             req.slot = slot
@@ -1243,6 +1399,7 @@ class PagedLLMEngine(LLMEngine):
             self._slot_blocks[slot] = table
             self._bt[slot] = 0
             self._bt[slot, :len(table)] = table
+            self._aid[slot] = aslot
             self._running[slot] = True
             self._tok[slot] = int(mig["tok"])
             self._pos[slot] = pos
@@ -1260,8 +1417,9 @@ class PagedLLMEngine(LLMEngine):
                 # the next same-prefix prompt or migration shares them
                 # without waiting for this request to finish and donate
                 n_full = pos // bs
-                self.prefix.insert(seq[:n_full * bs].tolist(),
-                                   table[:n_full])
+                self.prefix.insert(
+                    self._prefix_key(seq[:n_full * bs].tolist(), mig_ad),
+                    table[:n_full])
         info = {"blocks_copied": n_copy, "blocks_shared": n_shared,
                 "tokens": pos, "blocks_total": total}
         if trace_ctx is not None:
@@ -1298,6 +1456,12 @@ class PagedLLMEngine(LLMEngine):
         st = self._prefill_state.pop(slot, None)
         self._running[slot] = False
         self._bt[slot] = 0
+        if self.adapters is not None and req.adapter is not None \
+                and self._aid[slot]:
+            # drop the request's adapter pin; the tenant stays resident
+            # (warm for the next same-tenant request, LRU otherwise)
+            self.adapters.release(req.adapter)
+        self._aid[slot] = 0
         self._held_idle.pop(req.rid, None)
         ent = self._req_host.pop(req.rid, None)
         if ent is not None:
@@ -1317,7 +1481,8 @@ class PagedLLMEngine(LLMEngine):
             n_avail = int(req.prompt.shape[0]) + len(req.tokens) - 1
             seq = np.concatenate(
                 [req.prompt, np.asarray(req.tokens, np.int32)])[:n_avail]
-            self.prefix.insert(seq.tolist(), table)
+            self.prefix.insert(
+                self._prefix_key(seq.tolist(), req.adapter), table)
         for b in table:
             if b != TRASH_BLOCK:
                 self.pool.release(b)
@@ -1400,5 +1565,8 @@ class PagedLLMEngine(LLMEngine):
                     "pool_k", "pool_v", "scale_k", "scale_v"),
                 "weight_bytes_per_chip": self.arena.device_bytes(
                     "weights"),
+                "adapter_slots": self.adapter_slots,
+                "adapters": (None if self.adapters is None
+                             else self.adapters.stats()),
             })
         return st

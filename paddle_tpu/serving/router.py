@@ -147,7 +147,7 @@ class Router:
         }
 
     def pick(self, replicas, est_tokens=0, deadline_s=None, shed=True,
-             prompt=None, role=None):
+             prompt=None, role=None, adapter=None):
         """Choose a replica for a request costing ``est_tokens`` decode
         tokens.  ``replicas`` is the candidate list (alive + warmed).
         Raises :class:`RetryAfter` when every queue is full or — with
@@ -181,6 +181,13 @@ class Router:
         replica already holding the longest prefix on EITHER tier instead
         of re-prefilling it elsewhere.  A pick won on a nonzero discount
         counts ``serving.fleet.prefix_routed``.
+
+        ``adapter`` extends the same cost model with tenant affinity:
+        a candidate whose adapter arena already holds the tenant's LoRA
+        factors gets an ``LLMEngine.adapter_peek`` token bonus (the cold
+        page-in it would not pay), so same-tenant traffic gravitates to
+        warm replicas; a pick won on a nonzero adapter bonus counts
+        ``serving.fleet.adapter_routed``.
         """
         level = self._admission_level()
         if level == "critical" and shed:
@@ -212,12 +219,16 @@ class Router:
             if prompt is not None:
                 probe = getattr(rep.engine, "prefix_probe", None)
                 if probe is not None:
-                    dev, host = probe(prompt)
+                    dev, host = probe(prompt, tenant=adapter)
                     peek = dev + (1.0 - self.restore_cost) * host
                 else:
-                    peek = rep.engine.prefix_peek(prompt)
-            cands.append((st["outstanding_tokens"] - peek, rep.idx,
-                          rep, st, peek))
+                    peek = rep.engine.prefix_peek(prompt, tenant=adapter)
+            apeek = 0.0
+            if adapter is not None:
+                apeek = getattr(rep.engine, "adapter_peek",
+                                lambda t: 0)(adapter)
+            cands.append((st["outstanding_tokens"] - peek - apeek,
+                          rep.idx, rep, st, peek, apeek))
         if not cands:
             raise RetryAfter(
                 "every replica queue is full",
@@ -225,9 +236,11 @@ class Router:
                 retry_after_hint=min(hints) if hints else None,
                 reason="backpressure")
         cands.sort(key=lambda t: (t[0], t[1]))
-        _, _, rep, st, peek = cands[0]
+        _, _, rep, st, peek, apeek = cands[0]
         if peek > 0:
             counters.inc("serving.fleet.prefix_routed")
+        if apeek > 0:
+            counters.inc("serving.fleet.adapter_routed")
         backlog = st["outstanding_tokens"]   # SLO math on the REAL backlog
         if shed and deadline_s is not None and st["decode_tps_ema"] > 0:
             tps = st["decode_tps_ema"]

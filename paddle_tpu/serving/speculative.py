@@ -371,14 +371,25 @@ class SpeculativeLLMEngine(PagedLLMEngine):
             K1 = self.spec_k + 1
 
             def build():
+                # draft proposes on the BASE model; verify scores under
+                # the target tenant's adapter, so Leviathan acceptance
+                # stays distribution-preserving per row — the adapter
+                # slab pytree + per-row ids lead the varargs when enabled
+                lora = self.adapters is not None
+
                 if self.kv_dtype:
                     def verify(w, pk, pv, sk, sv, bt, pos0, nv, keys_data,
                                do_sample, temp, top_k, top_p, *tq):
                         counters.inc("serving.retraces")
+                        if lora:
+                            aw, aid, *tq = tq
+                        else:
+                            aw = aid = None
                         toks = jnp.stack(tq[:K1], axis=1)
                         q = jnp.stack(tq[K1:], axis=1)
                         logits, pk, pv, sk, sv = model.verify_paged(
-                            w, toks, pos0, nv, bt, pk, pv, sk, sv)
+                            w, toks, pos0, nv, bt, pk, pv, sk, sv,
+                            adapters=aw, adapter_ids=aid)
                         emit, n_emit, new_keys = _acceptance(
                             logits, toks, q, nv, keys_data, do_sample,
                             temp, top_k, top_p)
@@ -388,10 +399,15 @@ class SpeculativeLLMEngine(PagedLLMEngine):
                 def verify(w, pk, pv, bt, pos0, nv, keys_data,
                            do_sample, temp, top_k, top_p, *tq):
                     counters.inc("serving.retraces")
+                    if lora:
+                        aw, aid, *tq = tq
+                    else:
+                        aw = aid = None
                     toks = jnp.stack(tq[:K1], axis=1)
                     q = jnp.stack(tq[K1:], axis=1)
                     logits, pk, pv = model.verify_paged(
-                        w, toks, pos0, nv, bt, pk, pv)
+                        w, toks, pos0, nv, bt, pk, pv,
+                        adapters=aw, adapter_ids=aid)
                     emit, n_emit, new_keys = _acceptance(
                         logits, toks, q, nv, keys_data, do_sample,
                         temp, top_k, top_p)
@@ -696,9 +712,15 @@ class SpeculativeLLMEngine(PagedLLMEngine):
             vhead = ((self._w, self._pk, self._pv, self._sk, self._sv)
                      if self.kv_dtype else (self._w, self._pk, self._pv))
             vdn = (1, 2, 3, 4) if self.kv_dtype else (1, 2)
+            if self.adapters is not None:
+                aid_eff = np.where(self._running, self._aid,
+                                   0).astype(np.int32)
+                aext = (self.adapters.slabs(), op(aid_eff))
+            else:
+                aext = ()
             vargs = (*vhead, op(bt_eff), op(pos0),
                      op(nv), op(self._keys), dosample,
-                     temp, topk, topp, *ts, *qs)
+                     temp, topk, topp, *aext, *ts, *qs)
             vname = self._prog_key(f"serving.verify_paged[k{self.spec_k}]")
             self._maybe_capture(vname, vf, *vargs)
             self._maybe_audit(vname, vf, *vargs, donate_argnums=vdn)

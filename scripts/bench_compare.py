@@ -52,7 +52,8 @@ import re
 import sys
 
 _HIGHER = ("tokens_per_sec", "mfu", "capacity_ratio", "goodput",
-           "hit_rate", "acceptance", "retention", "vs_baseline")
+           "hit_rate", "acceptance", "retention", "vs_baseline",
+           "tenants_per")
 _LOWER_RE = re.compile(
     r"(ttft|itl|queue_wait|latency|step_time|save|restore)"
     r"|(_ms$)|(^|\.)(p50|p95|p99|mean)(_ms)?$")

@@ -114,6 +114,25 @@ identity and zero retraces, and the ledger it leaves behind must carry
 MFU/roofline gauges that survive ``GET /programs`` and a
 ``bench_compare.py --attribute`` run that names the dominant program.
 
+A thirteenth phase gates tensor-parallel serving over the StateArena
+(``serving.arena``): an mp2 paged engine must be token-identical to the
+single-device engine (greedy AND seeded) with the zero-steady-retrace
+economics and dispatch counts unchanged, the KV pool genuinely
+head-sharded per chip, and every cross-chip reduction an in-graph
+collective under the auditor's compiled-HLO census.
+
+A fourteenth phase gates multi-tenant LoRA serving
+(``serving.adapters``): ONE compiled decode program serves any tenant
+mix — a heterogeneous batch (three tenants + a base row in the same
+decode step) must be token-identical to running each tenant
+sequentially, base-only traffic through an adapter engine must match
+the adapter-free twin row for row, the warm steady window must move
+ZERO retraces/hydrates/syncs/arena-misses with dispatch counts equal to
+the adapter-free reference, and an eviction-then-reuse cycle (more
+tenants than arena slots) must page the evicted tenant back in warm —
+``serving.adapter.loads`` moves, programs never retrace, tokens never
+change.
+
 Prints one JSON line; raises AssertionError on any violation.  Wired as a
 tier-1 test via tests/test_profiler.py.  Run directly:
 ``python scripts/check_counters.py``.
@@ -1584,6 +1603,114 @@ def run():
         mssteady = {"skipped":
                     f"needs 2 devices, have {jax.device_count()}"}
 
+    # ---- adapters gate: multi-tenant LoRA serving.  Adapter ids are
+    # OPERANDS, so one compiled program serves any tenant mix: the
+    # heterogeneous batch below (base + three tenants in the same decode
+    # step) must match per-tenant sequential runs token for token, hold
+    # the zero-retrace steady economics with dispatch counts equal to
+    # the adapter-free twin, and survive an eviction-then-reuse cycle
+    # with loads moving but programs never retracing.
+    from paddle_tpu.serving.adapters import random_lora_factors as _alf
+
+    ad_tenants = ("acme", "bravo", "coyote")
+    ad_factors = {t: _alf(scfg, 3, seed=10 + i, scale=1.0)
+                  for i, t in enumerate(ad_tenants)}
+    ad_prompts = [rng.randint(0, 64, size=n).tolist()
+                  for n in (5, 9, 5, 9)]
+    ad_mix = (None, "acme", "bravo", "coyote")
+
+    def ad_engine(slots=5, **kw):
+        if slots:
+            kw.update(adapter_slots=slots, adapter_rank=4)
+        return LLMEngine(smodel, max_slots=4, max_seq_len=32,
+                         min_bucket=4, kv_layout="paged", block_size=4,
+                         prefill_chunk=8, **kw)
+
+    def ad_run(eng_, mix=ad_mix):
+        hs = [eng_.add_request(p, max_new_tokens=3, seed=21 + i,
+                               adapter=t)
+              for i, (p, t) in enumerate(zip(ad_prompts, mix))]
+        while not all(h.is_finished for h in hs):
+            eng_.step()
+        return [list(h.tokens) for h in hs]
+
+    # adapter-free twin over the identical workload: the dispatch
+    # economics reference AND the per-row base tokens
+    ad_ref_eng = ad_engine(slots=0)
+    ad_ref_tokens = ad_run(ad_ref_eng, mix=(None,) * 4)     # warm
+    ad_ref_before = counters.snapshot()
+    if ad_run(ad_ref_eng, mix=(None,) * 4) != ad_ref_tokens:
+        violations["adapters:ref_determinism"] = ("drift", ad_ref_tokens)
+    ad_ref = counters.delta(ad_ref_before)
+
+    ad_eng = ad_engine()
+    for t in ad_tenants:
+        ad_eng.register_adapter(t, ad_factors[t])
+    ad_mixed = ad_run(ad_eng)      # warm: traces +lora programs, cold loads
+    # base row bitwise passthrough; every tenant row diverges from base
+    if ad_mixed[0] != ad_ref_tokens[0]:
+        violations["adapters:base_passthrough"] = (ad_mixed[0],
+                                                   ad_ref_tokens[0])
+    for i, t in enumerate(ad_mix[1:], start=1):
+        if ad_mixed[i] == ad_ref_tokens[i]:
+            violations[f"adapters:inert:{t}"] = (ad_mixed[i],
+                                                 "!= base tokens")
+    # base-ONLY traffic through the adapter engine: adapter-free twin
+    # row for row (slot 0 selects the un-adapted activations themselves)
+    if ad_run(ad_eng, mix=(None,) * 4) != ad_ref_tokens:
+        violations["adapters:base_only_identity"] = ("drift",
+                                                     ad_ref_tokens)
+    # heterogeneous batch == per-tenant sequential on a fresh engine
+    ad_seq_eng = ad_engine()
+    for t in ad_tenants:
+        ad_seq_eng.register_adapter(t, ad_factors[t])
+    for i, t in enumerate(ad_mix[1:], start=1):
+        h_ = ad_seq_eng.add_request(ad_prompts[i], max_new_tokens=3,
+                                    seed=21 + i, adapter=t)
+        while not h_.is_finished:
+            ad_seq_eng.step()
+        if list(h_.tokens) != ad_mixed[i]:
+            violations[f"adapters:sequential:{t}"] = (list(h_.tokens),
+                                                      ad_mixed[i])
+    # warm steady window: ONE program economy — zero retraces/hydrates/
+    # syncs/arena misses, zero adapter loads (all tenants resident),
+    # dispatch counts equal to the adapter-free twin
+    ad_before = counters.snapshot()
+    if ad_run(ad_eng) != ad_mixed:
+        violations["adapters:determinism"] = ("drift", ad_mixed)
+    adsteady = counters.delta(ad_before)
+    for k in ("serving.retraces", "jit.traces", "jit.hydrates",
+              "jit.syncs", "serving.arena.program_misses",
+              "serving.arena.program_rebuilds", "serving.adapter.loads",
+              "serving.adapter.evictions"):
+        if adsteady.get(k, 0):
+            violations[f"adapters:{k}"] = (adsteady.get(k, 0), 0)
+    for k in ("serving.decode_steps", "serving.kv.prefill_chunks",
+              "serving.prefill_batches"):
+        if adsteady.get(k, 0) != ad_ref.get(k, 0):
+            violations[f"adapters:dispatch:{k}"] = (adsteady.get(k, 0),
+                                                    ad_ref.get(k, 0))
+    # eviction-then-reuse: three MORE tenants through the 5-slot arena
+    # force at least one LRU eviction; reloading the original mix pages
+    # the evicted tenant back in — loads move, programs never retrace,
+    # tokens never change
+    for j, t in enumerate(("dingo", "echo", "foxtrot")):
+        ad_eng.register_adapter(t, _alf(scfg, 3, seed=40 + j, scale=1.0))
+    ad_run(ad_eng, mix=(None, "dingo", "echo", "foxtrot"))
+    ad_stats = ad_eng.stats()["adapters"]
+    if ad_stats["evictions"] < 1:
+        violations["adapters:evictions"] = (ad_stats["evictions"], ">=1")
+    ad_re_before = counters.snapshot()
+    if ad_run(ad_eng) != ad_mixed:
+        violations["adapters:reuse_identity"] = ("drift", ad_mixed)
+    ad_reuse = counters.delta(ad_re_before)
+    if ad_reuse.get("serving.adapter.loads", 0) < 1:
+        violations["adapters:reuse_loads"] = (
+            ad_reuse.get("serving.adapter.loads", 0), ">=1")
+    for k in ("serving.retraces", "jit.traces"):
+        if ad_reuse.get(k, 0):
+            violations[f"adapters:reuse:{k}"] = (ad_reuse.get(k, 0), 0)
+
     result = {"metric": "steady_state_counter_violations",
               "value": len(violations),
               "unit": f"violations/{MEASURE} steps "
@@ -1652,6 +1779,17 @@ def run():
                                 "fixtures": fixture_got},
               "meshserve_delta": {k: v for k, v in mssteady.items()
                                   if not k.endswith("_ns")},
+              "adapters_delta": {
+                  "steady": {k: v for k, v in adsteady.items()
+                             if k.startswith(("serving.adapter.",
+                                              "serving.retraces",
+                                              "jit.traces"))},
+                  "reuse": {k: v for k, v in ad_reuse.items()
+                            if k.startswith(("serving.adapter.",
+                                             "serving.retraces",
+                                             "jit.traces"))},
+                  "evictions": ad_stats["evictions"],
+                  "resident": ad_stats["resident"]},
               "devicetime": {"off": _pick(dt_off), "on": _pick(dt_on),
                              "off_moved": dt_off_moved,
                              "dispatches": dt_disp,

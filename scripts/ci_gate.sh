@@ -16,7 +16,13 @@
 # decode over the StateArena: token identity vs single-device, zero
 # steady retraces/hydrates/host-syncs with dispatch counts unchanged,
 # the KV pool genuinely head-sharded per chip, and the audit census
-# proving in-graph collectives only — zero host launches).
+# proving in-graph collectives only — zero host launches), and the
+# adapters phase (multi-tenant LoRA serving: a heterogeneous batch of
+# three tenants + base rows token-identical to per-tenant sequential
+# through ONE compiled decode program, base rows bitwise passthrough,
+# zero steady retraces/loads with dispatch counts equal to the
+# adapter-free twin, and eviction-then-reuse paging tenants back in
+# warm — loads move, programs never retrace).
 #
 # Usage: scripts/ci_gate.sh        (from anywhere; cd's to the repo root)
 set -euo pipefail
@@ -38,7 +44,7 @@ elif [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
-echo "== ci_gate: steady-state counter invariants (incl. disagg, tiering, devicetime, mesh-serving) =="
+echo "== ci_gate: steady-state counter invariants (incl. disagg, tiering, devicetime, mesh-serving, adapters) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PYTHONPATH=. \
     python scripts/check_counters.py
 
